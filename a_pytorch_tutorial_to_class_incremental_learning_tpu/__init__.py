@@ -1,0 +1,18 @@
+"""TPU-native class-incremental learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``G-U-N/a-PyTorch-Tutorial-to-Class-Incremental-Learning`` (the WA method,
+"Maintaining Discrimination and Fairness in Class Incremental Learning"):
+rehearsal memory with herding exemplar selection, knowledge distillation from
+the previous-task model, a growing multi-head classifier re-expressed as one
+statically-shaped masked weight matrix (a single XLA compilation covers every
+task), post-task weight alignment, and data-parallel training over a
+``jax.sharding.Mesh`` instead of DDP/NCCL.
+
+Import as ``import a_pytorch_tutorial_to_class_incremental_learning_tpu as cil_tpu``
+or use the ``cil_tpu`` alias module at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from .config import CilConfig  # noqa: F401

@@ -1,0 +1,15 @@
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+    replicated,
+    shard_params,
+)
+from .dist import (  # noqa: F401
+    barrier,
+    init_distributed_mode,
+    is_main_process,
+    setup_for_distributed,
+)

@@ -1,0 +1,140 @@
+"""Metric smoothing and logging.
+
+TPU-native counterpart of the reference's observability layer
+(``SmoothedValue``/``MetricLogger``, reference utils.py:22-118).  Differences
+by design:
+
+* Values arriving from jitted steps are already *global* — the train/eval
+  steps run on the full logical batch under ``jax.jit`` over the mesh, so the
+  per-rank ``all_reduce`` of ``[count, total]`` (reference utils.py:36-43)
+  is unnecessary inside a single process.  ``synchronize_between_processes``
+  remains for the multi-host case, where it sums ``[count, total]`` over
+  processes with a host-level allreduce.
+* No CUDA tensors: everything is plain Python floats / numpy.
+"""
+
+from __future__ import annotations
+
+import statistics
+from collections import defaultdict, deque
+from typing import Dict
+
+import numpy as np
+
+
+def _to_float(v) -> float:
+    """Accept python numbers, 0-d numpy arrays and jax arrays."""
+    if hasattr(v, "item"):
+        return float(v.item())
+    return float(v)
+
+
+class SmoothedValue:
+    """Sliding-window smoothed metric with global totals.
+
+    Same surface as reference utils.py:22-73: ``update(value, n)``, window
+    ``median``/``avg``, ``global_avg``, ``max``, ``value`` and a format
+    string defaulting to ``"{median:.4f} ({global_avg:.4f})"``.
+    """
+
+    def __init__(self, window_size: int = 20, fmt: str | None = None):
+        self.window: deque = deque(maxlen=window_size)
+        self.total = 0.0
+        self.count = 0
+        self.fmt = fmt or "{median:.4f} ({global_avg:.4f})"
+
+    def update(self, value, n: int = 1) -> None:
+        value = _to_float(value)
+        self.window.append(value)
+        self.count += n
+        self.total += value * n
+
+    def synchronize_between_processes(self) -> None:
+        """Sum ``[count, total]`` across JAX processes (multi-host only).
+
+        Counterpart of the float64 NCCL all-reduce at reference
+        utils.py:36-43.  Single-process (including single-process
+        multi-device) is a no-op because step metrics are already global.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        t = multihost_utils.process_allgather(
+            np.asarray([self.count, self.total], dtype=np.float64)
+        )
+        t = np.sum(t, axis=0)
+        self.count = int(t[0])
+        self.total = float(t[1])
+
+    @property
+    def median(self) -> float:
+        return statistics.median(self.window) if self.window else 0.0
+
+    @property
+    def avg(self) -> float:
+        return sum(self.window) / len(self.window) if self.window else 0.0
+
+    @property
+    def global_avg(self) -> float:
+        return self.total / max(self.count, 1)
+
+    @property
+    def max(self) -> float:
+        return max(self.window) if self.window else 0.0
+
+    @property
+    def value(self) -> float:
+        return self.window[-1] if self.window else 0.0
+
+    def __str__(self) -> str:
+        return self.fmt.format(
+            median=self.median,
+            avg=self.avg,
+            global_avg=self.global_avg,
+            max=self.max,
+            value=self.value,
+        )
+
+
+class MetricLogger:
+    """Named collection of :class:`SmoothedValue` meters.
+
+    Same surface as reference utils.py:76-118 (``update(**kw)``, attribute
+    access to meters, ``synchronize_between_processes``, joined ``__str__``).
+    """
+
+    def __init__(self, delimiter: str = "\t"):
+        self.meters: Dict[str, SmoothedValue] = defaultdict(SmoothedValue)
+        self.delimiter = delimiter
+
+    def update(self, **kwargs) -> None:
+        for k, v in kwargs.items():
+            if v is None:
+                continue
+            self.meters[k].update(_to_float(v))
+
+    def update_dict(self, d) -> None:
+        self.update(**d)
+
+    def __getattr__(self, attr: str):
+        meters = self.__dict__.get("meters")
+        if meters is not None and attr in meters:
+            return meters[attr]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{attr}'"
+        )
+
+    def __str__(self) -> str:
+        return self.delimiter.join(
+            f"{name}: {meter}" for name, meter in self.meters.items()
+        )
+
+    def synchronize_between_processes(self) -> None:
+        for meter in self.meters.values():
+            meter.synchronize_between_processes()
+
+    def add_meter(self, name: str, meter: SmoothedValue) -> None:
+        self.meters[name] = meter

@@ -1,0 +1,6 @@
+"""Convenience alias: ``import cil_tpu`` for the long-named package."""
+import sys as _sys
+
+import a_pytorch_tutorial_to_class_incremental_learning_tpu as _pkg
+
+_sys.modules[__name__] = _pkg

@@ -1,0 +1,36 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The reference has no tests at all (SURVEY.md §4); we test distributed
+behaviour without a pod by faking 8 host devices, the standard JAX trick.
+Environment variables must be set before jax initializes its backends, hence
+the module-level assignment in conftest.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep XLA's CPU compiler from oversubscribing the (often small) test machine.
+os.environ.setdefault("XLA_CPU_MULTI_THREAD_EAGER", "false")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The environment may pin JAX_PLATFORMS to an accelerator plugin; tests always
+# run on the virtual 8-device CPU mesh, so force the platform via jax.config
+# (must happen before any backend is initialized).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

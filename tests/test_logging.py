@@ -1,0 +1,99 @@
+"""Tests for the metric layer (reference utils.py:22-118 semantics)."""
+
+import numpy as np
+import pytest
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils import (
+    MetricLogger,
+    SmoothedValue,
+)
+
+
+def test_smoothed_value_stats():
+    v = SmoothedValue(window_size=3)
+    for x in [1.0, 2.0, 3.0, 4.0]:
+        v.update(x)
+    # window holds the last 3
+    assert v.median == 3.0
+    assert v.avg == pytest.approx(3.0)
+    assert v.max == 4.0
+    assert v.value == 4.0
+    # global average covers everything
+    assert v.global_avg == pytest.approx(10.0 / 4)
+
+
+def test_smoothed_value_weighted_update():
+    v = SmoothedValue()
+    v.update(80.0, n=128)  # batch-weighted accuracy, like eval acc meters
+    v.update(60.0, n=64)
+    assert v.global_avg == pytest.approx((80 * 128 + 60 * 64) / 192)
+
+
+def test_smoothed_value_accepts_arrays():
+    import jax.numpy as jnp
+
+    v = SmoothedValue()
+    v.update(jnp.asarray(2.5))
+    v.update(np.float32(1.5))
+    assert v.global_avg == pytest.approx(2.0)
+
+
+def test_metric_logger_surface():
+    ml = MetricLogger(delimiter="  ")
+    ml.update(loss=1.0, acc1=50.0)
+    ml.update(loss=3.0, acc1=70.0)
+    assert ml.loss.global_avg == pytest.approx(2.0)
+    assert ml.acc1.value == 70.0
+    s = str(ml)
+    assert "loss:" in s and "acc1:" in s
+    with pytest.raises(AttributeError):
+        ml.nonexistent_meter
+    # None values are skipped (reference utils.py:83-84)
+    ml.update(kd=None)
+    assert "kd" not in ml.meters
+    # single-process sync is a no-op
+    ml.synchronize_between_processes()
+    assert ml.loss.global_avg == pytest.approx(2.0)
+
+
+def test_config_increments():
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu import CilConfig
+
+    c = CilConfig(num_bases=50, increment=10)
+    assert c.increments(100) == (50,) + (10,) * 5
+    b0 = CilConfig(num_bases=0, increment=10)
+    assert b0.increments(100) == (10,) * 10
+    with pytest.raises(ValueError):
+        CilConfig(num_bases=50, increment=7).increments(100)
+
+
+def test_config_normalization_quirk():
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu import CilConfig
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import (
+        CIFAR_MEAN,
+        IMAGENET_MEAN,
+    )
+
+    # Default lowercase "cifar" keeps ImageNet stats (reference utils.py:231).
+    assert CilConfig(data_set="cifar").normalization_stats()[0] == IMAGENET_MEAN
+    assert CilConfig(data_set="CIFAR").normalization_stats()[0] == CIFAR_MEAN
+
+
+def test_mesh_creation(devices8):
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel import (
+        make_mesh,
+        batch_sharding,
+    )
+
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
+    mesh2 = make_mesh((4, 2))
+    assert mesh2.shape["data"] == 4 and mesh2.shape["model"] == 2
+    with pytest.raises(ValueError):
+        make_mesh((3, 2))
+    sh = batch_sharding(mesh)
+    import jax
+    import numpy as np
+
+    x = jax.device_put(np.zeros((16, 4)), sh)
+    assert len(x.addressable_shards) == 8
