@@ -1,0 +1,36 @@
+"""Model layer: Flax CIFAR ResNet backbone + static masked CIL classifier.
+
+L3/L4 of the layer map (SURVEY.md §1): the reference's ``resnet.py`` backbone
+and ``CilModel``/``CilClassifier`` (reference ``template.py:87-166``),
+re-designed shape-static for XLA (see ``classifier.py`` module docstring).
+"""
+
+from .resnet import (  # noqa: F401
+    BasicBlock,
+    CifarResNet,
+    DownsampleA,
+    get_backbone,
+    resnet10mnist,
+    resnet20,
+    resnet20mnist,
+    resnet32,
+    resnet32mnist,
+    resnet44,
+    resnet56,
+    resnet110,
+)
+from .classifier import (  # noqa: F401
+    NEG_INF,
+    grow_head,
+    masked_logits,
+    round_up,
+    torch_linear_init,
+    weight_align,
+)
+from .cil_model import (  # noqa: F401
+    CilModel,
+    align,
+    create_model,
+    grow,
+    init_backbone,
+)

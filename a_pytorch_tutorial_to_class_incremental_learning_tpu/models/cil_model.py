@@ -1,0 +1,149 @@
+"""The CIL model: backbone + static masked classifier, as a Flax module.
+
+Counterpart of the reference ``CilModel`` (reference ``template.py:107-166``):
+``forward(x) -> (logits, features)``, ``extract_vector`` = backbone features
+only, per-task head growth, post-task weight alignment.  Differences that are
+deliberate TPU-first design, not omissions:
+
+* ``copy()``/``freeze()`` (reference ``template.py:125-144``) vanish: JAX
+  pytrees are immutable, so the teacher snapshot is simply the variables
+  pytree held at the end of the previous task — no deepcopy, no
+  requires_grad bookkeeping.  Gradients never flow to the teacher because
+  the loss is differentiated only with respect to the student's params.
+* ``prev_model_adaption``/``after_model_adaption`` become pure functions over
+  the variables pytree (:func:`grow`, :func:`align`), run host-side between
+  tasks; array shapes never change, so the jitted train step compiles once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.core import freeze, unfreeze
+
+from .classifier import grow_head, masked_logits, round_up, weight_align
+from .resnet import get_backbone
+
+
+class CilModel(nn.Module):
+    """Backbone + full-width masked classification head.
+
+    ``width`` is the static logits width: at least ``nb_classes``, optionally
+    rounded up (e.g. to a multiple of the mesh model-axis) for sharding.
+    """
+
+    backbone_name: str = "resnet32"
+    width: int = 100
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.backbone = get_backbone(self.backbone_name, dtype=self.dtype)
+        # Allocated zero; live columns are filled per task by `grow` with the
+        # torch-Linear-equivalent init (classifier.py).
+        self.fc_kernel = self.param(
+            "fc_kernel",
+            nn.initializers.zeros_init(),
+            (self.backbone.out_dim, self.width),
+        )
+        self.fc_bias = self.param(
+            "fc_bias", nn.initializers.zeros_init(), (self.width,)
+        )
+
+    def __call__(
+        self, x: jax.Array, num_active: jax.Array, train: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
+        """``(images, num_active) -> (masked logits [B, width], features [B, 64])``.
+
+        Reference ``CilModel.forward`` (``template.py:120-123``).
+        """
+        feats = self.backbone(x, train=train)
+        fc = {"kernel": self.fc_kernel, "bias": self.fc_bias}
+        return masked_logits(feats, fc, num_active), feats
+
+    def extract_vector(self, x: jax.Array, train: bool = False) -> jax.Array:
+        """Backbone features only (reference ``template.py:117-118``)."""
+        return self.backbone(x, train=train)
+
+    @property
+    def feature_dim(self) -> int:
+        return 64
+
+
+# --------------------------------------------------------------------------- #
+# Host-side lifecycle helpers (between-task, never inside the compiled step)
+# --------------------------------------------------------------------------- #
+
+
+def create_model(
+    backbone_name: str,
+    nb_classes: int,
+    dtype: Any = jnp.float32,
+    width_multiple: int = 1,
+    input_size: int = 32,
+    channels: int = 3,
+) -> Tuple[CilModel, dict]:
+    """Build the module and its zero-head variables.
+
+    Returns ``(model, variables)`` where ``variables`` holds ``params`` and
+    ``batch_stats``.  The head starts fully inactive (``num_active=0``);
+    :func:`grow` activates column ranges per task.
+    """
+    width = round_up(nb_classes, max(width_multiple, 1))
+    model = CilModel(backbone_name=backbone_name, width=width, dtype=dtype)
+    dummy = jnp.zeros((1, input_size, input_size, channels), jnp.float32)
+    variables = model.init(
+        jax.random.PRNGKey(0), dummy, num_active=jnp.int32(0), train=False
+    )
+    return model, variables
+
+
+def init_backbone(variables: dict, key: jax.Array, model: CilModel,
+                  input_size: int = 32, channels: int = 3) -> dict:
+    """Re-draw backbone params from ``key`` (the seeded experiment key).
+
+    ``create_model`` uses a fixed key for shape inference; this replaces the
+    backbone params with ones drawn from the experiment seed, leaving the
+    (zero) head untouched.
+    """
+    dummy = jnp.zeros((1, input_size, input_size, channels), jnp.float32)
+    fresh = model.init(key, dummy, num_active=jnp.int32(0), train=False)
+    fresh = unfreeze(fresh)
+    old = unfreeze(variables)
+    fresh["params"]["fc_kernel"] = old["params"]["fc_kernel"]
+    fresh["params"]["fc_bias"] = old["params"]["fc_bias"]
+    return freeze(fresh)
+
+
+def _get_fc(variables: dict) -> dict:
+    return {
+        "kernel": variables["params"]["fc_kernel"],
+        "bias": variables["params"]["fc_bias"],
+    }
+
+
+def _set_fc(variables: dict, fc: dict) -> dict:
+    v = unfreeze(variables)
+    v["params"]["fc_kernel"] = fc["kernel"]
+    v["params"]["fc_bias"] = fc["bias"]
+    return freeze(v)
+
+
+def grow(variables: dict, key: jax.Array, known: int, nb_new: int) -> dict:
+    """Activate (initialize) the next task's head columns.
+
+    Equivalent of ``prev_model_adaption`` (reference ``template.py:146-150``).
+    """
+    return _set_fc(variables, grow_head(_get_fc(variables), key, known, nb_new))
+
+
+def align(variables: dict, known: int, nb_new: int) -> Tuple[dict, float]:
+    """Post-task weight alignment; no-op gate lives with the caller.
+
+    Equivalent of ``after_model_adaption`` -> ``weight_align``
+    (reference ``template.py:152-166``).  Returns ``(variables, gamma)``.
+    """
+    fc, gamma = weight_align(_get_fc(variables), known, nb_new)
+    return _set_fc(variables, fc), float(gamma)

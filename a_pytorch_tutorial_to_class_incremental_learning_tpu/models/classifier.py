@@ -1,0 +1,116 @@
+"""Growable multi-head classifier re-designed for XLA: one static masked matrix.
+
+The reference grows an ``nn.ModuleList`` of per-task ``Linear(64, k_t)`` heads
+and concatenates their outputs (reference ``template.py:87-104``), which under
+XLA would change the logits shape every task and force a recompile of the
+train step.  TPU-first redesign (SURVEY.md §7 hard-part 1, option b): allocate
+the full-width weight matrix ``[feat_dim, width]`` up front, treat the column
+range ``[0, num_active)`` as the live classes, and mask the rest to a large
+negative value.  ``num_active`` is a *traced* scalar, so a single compilation
+serves the whole 10-task run; growth is a host-side in-place column
+initialization, not a new module.
+
+Because new classes always occupy the highest label indices (continuum's
+label remapping, SURVEY.md #18), "the newest head" is exactly the column
+slice ``[known, known+nb_new)`` — which makes weight alignment
+(reference ``template.py:156-166``) a tiny pure function over column slices.
+
+``width`` may be rounded up beyond ``nb_classes`` so the class dimension can
+be sharded over a model axis of the mesh (padding columns are permanently
+masked).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Finite stand-in for -inf: keeps softmax/top-k exact for the active columns
+# without generating NaNs in masked reductions.
+NEG_INF = -1e9
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def torch_linear_init(
+    key: jax.Array, feat_dim: int, nb_new: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-head init matching ``torch.nn.Linear``'s default.
+
+    The reference creates each head as a fresh ``nn.Linear`` (reference
+    ``template.py:91,104``), whose default init is kaiming-uniform with
+    a=sqrt(5): weight and bias both ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
+    Returns ``(kernel_cols [feat_dim, nb_new], bias_cols [nb_new])``.
+    """
+    bound = 1.0 / (feat_dim ** 0.5)
+    wk, bk = jax.random.split(key)
+    kernel = jax.random.uniform(
+        wk, (feat_dim, nb_new), minval=-bound, maxval=bound, dtype=jnp.float32
+    )
+    bias = jax.random.uniform(
+        bk, (nb_new,), minval=-bound, maxval=bound, dtype=jnp.float32
+    )
+    return kernel, bias
+
+
+def grow_head(
+    fc_params: dict, key: jax.Array, known: int, nb_new: int
+) -> dict:
+    """Initialize the column slice for a new task's head.
+
+    Equivalent of ``CilClassifier.adaption`` / lazy first-head construction
+    (reference ``template.py:103-104,146-150``) without changing any array
+    shape.  Host-side, once per task — never inside the compiled step.
+    """
+    kernel, bias = fc_params["kernel"], fc_params["bias"]
+    feat_dim, width = kernel.shape
+    if known + nb_new > width:
+        raise ValueError(
+            f"head overflow: known={known} + new={nb_new} > width={width}"
+        )
+    new_k, new_b = torch_linear_init(key, feat_dim, nb_new)
+    kernel = kernel.at[:, known : known + nb_new].set(new_k)
+    bias = bias.at[known : known + nb_new].set(new_b)
+    return {"kernel": kernel, "bias": bias}
+
+
+def masked_logits(
+    features: jax.Array, fc_params: dict, num_active: jax.Array
+) -> jax.Array:
+    """``[B, feat] -> [B, width]`` logits with columns >= num_active masked.
+
+    The concat-of-heads forward (reference ``template.py:99-101``) collapses
+    to one MXU-friendly matmul; masking replaces shape growth.
+    """
+    logits = features @ fc_params["kernel"] + fc_params["bias"]
+    mask = jnp.arange(logits.shape[-1]) < num_active
+    return jnp.where(mask, logits, NEG_INF)
+
+
+def weight_align(
+    fc_params: dict, known: int, nb_new: int
+) -> Tuple[dict, jax.Array]:
+    """The WA method: rescale the newest head to the old heads' mean norm.
+
+    Reference ``CilModel.weight_align`` (``template.py:156-166``):
+    per-class L2 norms of the stacked head weights, gamma =
+    mean(old-class norms) / mean(new-class norms), newest head's weight
+    (not bias) scaled by gamma.  Pure ``W -> W`` function; runs once per
+    task on the host.  Returns ``(new_fc_params, gamma)``.
+    """
+    if known <= 0 or nb_new <= 0:
+        # The reference gates alignment on task_id > 0 (template.py:152-154);
+        # enforce the contract here too — known=0 would make gamma a NaN.
+        raise ValueError(
+            f"weight_align needs old and new classes (known={known}, nb_new={nb_new})"
+        )
+    kernel = fc_params["kernel"]
+    norms = jnp.linalg.norm(kernel[:, : known + nb_new], axis=0)
+    gamma = jnp.mean(norms[:known]) / jnp.mean(norms[known:])
+    new_cols = kernel[:, known : known + nb_new] * gamma
+    kernel = kernel.at[:, known : known + nb_new].set(new_cols)
+    return {"kernel": kernel, "bias": fc_params["bias"]}, gamma

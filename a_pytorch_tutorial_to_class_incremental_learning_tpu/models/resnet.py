@@ -1,0 +1,205 @@
+"""CIFAR ResNet backbone as a Flax module (TPU-native, NHWC layout).
+
+Behavioural counterpart of the reference backbone (reference ``resnet.py:9-159``):
+a 3x3 stem conv -> BN -> ReLU, three stages of basic blocks at widths 16/32/64
+with strides 1/2/2, an 8x8 average pool and a flatten to a 64-d feature vector;
+depth must be 6n+2.  The residual shortcut is "option A" (reference
+``resnet.py:9-17``): a stride-2 1x1 average pool (i.e. spatial subsampling)
+followed by channel doubling via concatenation with zeros — no learned
+projection.
+
+TPU-first design notes (not a port):
+
+* NHWC layout throughout — the native layout for XLA:TPU convolutions; the
+  reference's NCHW is a CUDA convention.
+* Initialization matches the reference numerically: conv weights are drawn
+  from ``Normal(0, sqrt(2 / (kh*kw*out_ch)))`` (reference ``resnet.py:82-85``),
+  BatchNorm starts at scale=1 / bias=0 (``resnet.py:86-88``).
+* BatchNorm statistics are computed over the **global** (sharded) batch when
+  the step is jitted over a mesh — XLA inserts the cross-device reductions.
+  The reference uses per-replica statistics (DDP without SyncBN); global
+  statistics are the idiomatic and slightly better-behaved choice on TPU
+  (SURVEY.md §7 item 2).
+* ``compute_dtype`` allows bfloat16 activations so convs land on the MXU in
+  its native precision; parameters and BN statistics stay float32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Matches torch's ``weight.data.normal_(0, sqrt(2/n))`` with
+# n = kh*kw*out_channels (reference resnet.py:83-85): variance-scaling with
+# scale 2.0 over fan-out; "normal" here is the untruncated normal with
+# stddev sqrt(2/fan_out), exactly torch's normal_.
+he_normal_torchlike = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class DownsampleA(nn.Module):
+    """Option-A shortcut: spatial stride-2 subsample + zero-channel concat.
+
+    Reference ``resnet.py:9-17``: ``AvgPool2d(kernel_size=1, stride=2)`` is
+    exactly a ``x[:, ::2, ::2, :]`` subsample in NHWC, and the channel count
+    doubles by concatenating a zero tensor.  Parameter-free.
+    """
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x[:, ::2, ::2, :]
+        return jnp.concatenate([x, jnp.zeros_like(x)], axis=-1)
+
+
+class BasicBlock(nn.Module):
+    """conv3x3-BN-ReLU-conv3x3-BN + shortcut, post-add ReLU.
+
+    Reference ``resnet.py:20-53``.  ``downsample=True`` selects the option-A
+    shortcut (set on the first block of stages 2/3).
+    """
+
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        residual = x
+        y = nn.Conv(
+            self.planes,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=1,
+            use_bias=False,
+            kernel_init=he_normal_torchlike,
+            dtype=self.dtype,
+            name="conv_a",
+        )(x)
+        y = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            name="bn_a",
+        )(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.planes,
+            (3, 3),
+            strides=(1, 1),
+            padding=1,
+            use_bias=False,
+            kernel_init=he_normal_torchlike,
+            dtype=self.dtype,
+            name="conv_b",
+        )(y)
+        y = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            name="bn_b",
+        )(y)
+        if self.downsample:
+            residual = DownsampleA(name="shortcut")(x)
+        return nn.relu(residual + y)
+
+
+class CifarResNet(nn.Module):
+    """6n+2 CIFAR ResNet producing a pooled feature vector.
+
+    ``__call__`` returns the flattened ``[B, 64]`` feature (the reference
+    backbone's only output, ``resnet.py:107-116``); classification heads live
+    in :class:`~..models.classifier.CilClassifier`.
+    """
+
+    depth: int = 32
+    channels: int = 3  # 1 for the MNIST variants (reference resnet.py:127-139)
+    dtype: Any = jnp.float32
+
+    @property
+    def out_dim(self) -> int:
+        return 64
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        assert (self.depth - 2) % 6 == 0, "depth should be one of 20, 32, 44, 56, 110"
+        assert x.shape[-1] == self.channels, (
+            f"expected {self.channels}-channel input (NHWC), got shape {x.shape}"
+        )
+        n = (self.depth - 2) // 6
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            16,
+            (3, 3),
+            strides=(1, 1),
+            padding=1,
+            use_bias=False,
+            kernel_init=he_normal_torchlike,
+            dtype=self.dtype,
+            name="conv_1_3x3",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            name="bn_1",
+        )(x)
+        x = nn.relu(x)
+        for stage, (planes, stride) in enumerate(((16, 1), (32, 2), (64, 2)), start=1):
+            for i in range(n):
+                first = i == 0
+                x = BasicBlock(
+                    planes=planes,
+                    stride=stride if first else 1,
+                    downsample=first and stage > 1,
+                    dtype=self.dtype,
+                    name=f"stage_{stage}_block_{i}",
+                )(x, train=train)
+        # Global 8x8 average pool + flatten -> [B, 64] feature vector
+        # (reference resnet.py:79,114-116).
+        x = jnp.mean(x, axis=(1, 2))
+        return x.astype(jnp.float32)
+
+
+def _factory(depth: int, channels: int = 3) -> Callable[..., CifarResNet]:
+    def make(dtype: Any = jnp.float32) -> CifarResNet:
+        return CifarResNet(depth=depth, channels=channels, dtype=dtype)
+
+    return make
+
+
+# Factory table mirroring the reference's constructors (resnet.py:122-159)
+# plus the backbone-flag dispatch (template.py:72-84).
+resnet20 = _factory(20)
+resnet32 = _factory(32)
+resnet44 = _factory(44)
+resnet56 = _factory(56)
+resnet110 = _factory(110)
+resnet10mnist = _factory(10, channels=1)
+resnet20mnist = _factory(20, channels=1)
+resnet32mnist = _factory(32, channels=1)
+
+_BACKBONES = {
+    "resnet20": resnet20,
+    "resnet32": resnet32,
+    "resnet44": resnet44,
+    "resnet56": resnet56,
+    "resnet110": resnet110,
+    "resnet10mnist": resnet10mnist,
+    "resnet20mnist": resnet20mnist,
+    "resnet32mnist": resnet32mnist,
+}
+
+
+def get_backbone(name: str, dtype: Any = jnp.float32) -> CifarResNet:
+    """Flag-string -> backbone module (reference ``template.py:72-84``)."""
+    try:
+        return _BACKBONES[name](dtype=dtype)
+    except KeyError:
+        raise NotImplementedError(f"Unknown backbone {name}") from None

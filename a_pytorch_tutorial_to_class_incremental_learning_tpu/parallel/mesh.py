@@ -56,17 +56,31 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# Exact param-path components that carry a class dimension as their last axis
+# (the CilModel masked head, models/cil_model.py); sharded over the model axis.
+_CLASS_DIM_PARAMS = ("fc_kernel", "fc_bias")
+
+
 def param_sharding(mesh: Mesh, path: Tuple[str, ...], value) -> NamedSharding:
     """Sharding rule for one parameter leaf.
 
     At the reference's model scale (a 0.46M-param CNN) everything is
-    replicated; classifier matrices ``[features, classes]`` are sharded over
+    replicated; the classifier head (class dimension last) is sharded over
     the ``model`` axis when it is wider than 1 so the design scales to
-    larger heads without code changes.
+    larger heads without code changes.  Matching is by exact path component
+    (not substring), and falls back to replication when the class dimension
+    does not divide the model-axis size — ``create_model(width_multiple=...)``
+    pads the head width so it does.
     """
     model_dim = mesh.shape[MODEL_AXIS]
-    if model_dim > 1 and getattr(value, "ndim", 0) == 2 and "head" in "/".join(path):
-        return NamedSharding(mesh, P(None, MODEL_AXIS))
+    if (
+        model_dim > 1
+        and any(p in _CLASS_DIM_PARAMS for p in path)
+        and getattr(value, "ndim", 0) >= 1
+        and value.shape[-1] % model_dim == 0
+    ):
+        spec = (None,) * (value.ndim - 1) + (MODEL_AXIS,)
+        return NamedSharding(mesh, P(*spec))
     return NamedSharding(mesh, P())
 
 

@@ -1,0 +1,206 @@
+"""Model-layer tests: torch parity, weight-align golden values, masked head.
+
+SURVEY.md §4 test strategy: numerical parity of the Flax backbone against the
+reference's torch implementation on identical weights, and golden-value tests
+for the WA math (reference template.py:156-166).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+    NEG_INF,
+    CilModel,
+    align,
+    create_model,
+    get_backbone,
+    grow,
+    masked_logits,
+    weight_align,
+)
+
+
+# --------------------------------------------------------------------------- #
+# Torch-CPU numerical parity (reference resnet.py forward vs Flax forward)
+# --------------------------------------------------------------------------- #
+
+
+def _torch_reference_resnet(depth, channels=3):
+    """Import the reference backbone (read-only mount) for parity checking."""
+    import sys
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from resnet import CifarResNet as TorchCifarResNet  # type: ignore
+        from resnet import ResNetBasicblock  # type: ignore
+    finally:
+        sys.path.remove("/root/reference")
+    return TorchCifarResNet(ResNetBasicblock, depth, num_classes=10, channels=channels)
+
+
+def _port_torch_weights(tmodel, variables):
+    """Copy torch weights into the Flax variables pytree (NCHW->HWIO)."""
+    import torch
+
+    from flax.core import unfreeze, freeze
+
+    v = unfreeze(variables)
+
+    def conv_w(m):
+        return jnp.asarray(m.weight.detach().numpy().transpose(2, 3, 1, 0))
+
+    def set_bn(dst_p, dst_s, m):
+        dst_p["scale"] = jnp.asarray(m.weight.detach().numpy())
+        dst_p["bias"] = jnp.asarray(m.bias.detach().numpy())
+        dst_s["mean"] = jnp.asarray(m.running_mean.detach().numpy())
+        dst_s["var"] = jnp.asarray(m.running_var.detach().numpy())
+
+    params, stats = v["params"], v["batch_stats"]
+    params["conv_1_3x3"]["kernel"] = conv_w(tmodel.conv_1_3x3)
+    set_bn(params["bn_1"], stats["bn_1"], tmodel.bn_1)
+    for stage_idx, tstage in enumerate(
+        (tmodel.stage_1, tmodel.stage_2, tmodel.stage_3), start=1
+    ):
+        for block_idx, tblock in enumerate(tstage):
+            name = f"stage_{stage_idx}_block_{block_idx}"
+            params[name]["conv_a"]["kernel"] = conv_w(tblock.conv_a)
+            params[name]["conv_b"]["kernel"] = conv_w(tblock.conv_b)
+            set_bn(params[name]["bn_a"], stats[name]["bn_a"], tblock.bn_a)
+            set_bn(params[name]["bn_b"], stats[name]["bn_b"], tblock.bn_b)
+    return freeze(v)
+
+
+@pytest.mark.parametrize("depth", [20, 32])
+def test_backbone_torch_parity(depth):
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(0)
+    tmodel = _torch_reference_resnet(depth).eval()
+    # Randomize BN running stats so parity covers the running-average path.
+    for m in tmodel.modules():
+        if isinstance(m, torch.nn.BatchNorm2d):
+            m.running_mean.normal_(0, 0.5)
+            m.running_var.uniform_(0.5, 1.5)
+
+    model = get_backbone(f"resnet{depth}")
+    x_nchw = np.random.RandomState(1).randn(4, 3, 32, 32).astype(np.float32)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(x_nchw.transpose(0, 2, 3, 1)), train=False
+    )
+    variables = _port_torch_weights(tmodel, variables)
+
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(x_nchw)).numpy()
+    out = model.apply(variables, jnp.asarray(x_nchw.transpose(0, 2, 3, 1)), train=False)
+    assert out.shape == (4, 64)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_backbone_train_mode_torch_parity():
+    """Batch-stat (training) BN path also matches torch on one forward."""
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(0)
+    tmodel = _torch_reference_resnet(20).train()
+    model = get_backbone("resnet20")
+    x_nchw = np.random.RandomState(2).randn(8, 3, 32, 32).astype(np.float32)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(x_nchw.transpose(0, 2, 3, 1)), train=False
+    )
+    variables = _port_torch_weights(tmodel, variables)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(x_nchw)).numpy()
+    out, _ = model.apply(
+        variables,
+        jnp.asarray(x_nchw.transpose(0, 2, 3, 1)),
+        train=True,
+        mutable=["batch_stats"],
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+# --------------------------------------------------------------------------- #
+# Weight alignment golden test (reference template.py:156-166 math)
+# --------------------------------------------------------------------------- #
+
+
+def test_weight_align_golden():
+    # Hand-built [feat=2, classes] matrix: old class norms 3,4 -> mean 3.5;
+    # new class norms 1,2 -> mean 1.5; gamma = 3.5/1.5.
+    kernel = jnp.array(
+        [[3.0, 0.0, 1.0, 0.0], [0.0, 4.0, 0.0, 2.0]], dtype=jnp.float32
+    )
+    bias = jnp.array([0.1, 0.2, 0.3, 0.4], jnp.float32)
+    fc, gamma = weight_align({"kernel": kernel, "bias": bias}, known=2, nb_new=2)
+    expected_gamma = 3.5 / 1.5
+    assert np.isclose(float(gamma), expected_gamma, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(fc["kernel"][:, 2:]),
+        np.asarray(kernel[:, 2:]) * expected_gamma,
+        rtol=1e-6,
+    )
+    # Old columns and all biases untouched (reference scales only the newest
+    # head's weight, template.py:166).
+    np.testing.assert_array_equal(np.asarray(fc["kernel"][:, :2]), np.asarray(kernel[:, :2]))
+    np.testing.assert_array_equal(np.asarray(fc["bias"]), np.asarray(bias))
+
+
+def test_weight_align_torch_parity():
+    """Same gamma and scaled weights as the reference's torch implementation."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    w = rng.randn(10, 64).astype(np.float32)  # torch layout [classes, feat]
+    nb_new = 4
+    tw = torch.from_numpy(w.copy())
+    norms = torch.norm(tw, dim=1)
+    gamma_ref = (norms[:-nb_new].mean() / norms[-nb_new:].mean()).item()
+    ref_new = (gamma_ref * tw[-nb_new:]).numpy()
+
+    fc, gamma = weight_align(
+        {"kernel": jnp.asarray(w.T), "bias": jnp.zeros(10)}, known=6, nb_new=4
+    )
+    assert np.isclose(gamma, gamma_ref, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fc["kernel"][:, 6:]).T, ref_new, rtol=1e-5
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Masked static head semantics
+# --------------------------------------------------------------------------- #
+
+
+def test_masked_head_grow_and_forward():
+    model, variables = create_model("resnet20", nb_classes=20)
+    key = jax.random.PRNGKey(7)
+    # Task 0: activate 10 classes.
+    variables = grow(variables, key, known=0, nb_new=10)
+    x = jnp.ones((2, 32, 32, 3))
+    logits, feats = model.apply(variables, x, num_active=jnp.int32(10), train=False)
+    assert logits.shape == (2, 20) and feats.shape == (2, 64)
+    assert np.all(np.asarray(logits[:, 10:]) == NEG_INF)
+    assert np.all(np.asarray(logits[:, :10]) > NEG_INF / 2)
+    # Growth initializes exactly the new slice, leaves old columns alone.
+    k0 = np.asarray(variables["params"]["fc_kernel"])
+    variables2 = grow(variables, jax.random.PRNGKey(8), known=10, nb_new=10)
+    k1 = np.asarray(variables2["params"]["fc_kernel"])
+    np.testing.assert_array_equal(k1[:, :10], k0[:, :10])
+    assert np.abs(k1[:, 10:]).max() > 0
+    assert np.all(np.abs(k1[:, 10:]) <= 1 / 8 + 1e-7)  # U(-1/sqrt(64), ..)
+
+
+def test_align_wrapper_roundtrip():
+    _, variables = create_model("resnet20", nb_classes=10)
+    variables = grow(variables, jax.random.PRNGKey(0), 0, 5)
+    variables = grow(variables, jax.random.PRNGKey(1), 5, 5)
+    aligned, gamma = align(variables, known=5, nb_new=5)
+    assert gamma > 0
+    k_old = np.asarray(variables["params"]["fc_kernel"])
+    k_new = np.asarray(aligned["params"]["fc_kernel"])
+    np.testing.assert_allclose(k_new[:, 5:], k_old[:, 5:] * gamma, rtol=1e-5)
+
+
+def test_width_rounding_for_model_axis():
+    model, variables = create_model("resnet20", nb_classes=100, width_multiple=8)
+    assert model.width == 104
+    assert variables["params"]["fc_kernel"].shape == (64, 104)
