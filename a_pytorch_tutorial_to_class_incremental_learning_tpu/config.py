@@ -37,6 +37,29 @@ IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 
 
+def compute_increments(
+    nb_classes: int, initial_increment: int, increment: int
+) -> Tuple[int, ...]:
+    """The single source of truth for the task split arithmetic.
+
+    ``[base, increment, increment, ...]`` with ``base = initial_increment`` or
+    ``increment`` when 0 (reference template.py:222-223; continuum's
+    ``initial_increment=0`` convention).  Shared by :class:`CilConfig` and
+    ``data.scenario.ClassIncremental`` so the config's view of the split can
+    never disagree with the scenario's.
+    """
+    base = initial_increment if initial_increment > 0 else increment
+    if base > nb_classes:
+        raise ValueError(f"num_bases={base} exceeds nb_classes={nb_classes}")
+    rest = nb_classes - base
+    if increment <= 0 or rest % increment != 0:
+        raise ValueError(
+            f"increment={increment} does not evenly divide the "
+            f"{rest} classes remaining after the base task"
+        )
+    return (base,) + (increment,) * (rest // increment)
+
+
 @dataclass(frozen=True)
 class CilConfig:
     """Static configuration for one class-incremental experiment.
@@ -114,16 +137,7 @@ class CilConfig:
         first task also uses ``increment`` (the B0 benchmark convention, same
         as continuum's ``initial_increment=0``).
         """
-        base = self.num_bases if self.num_bases > 0 else self.increment
-        if base > nb_classes:
-            raise ValueError(f"num_bases={base} exceeds nb_classes={nb_classes}")
-        rest = nb_classes - base
-        if rest % self.increment != 0:
-            raise ValueError(
-                f"increment={self.increment} does not evenly divide the "
-                f"{rest} classes remaining after the base task"
-            )
-        return (base,) + (self.increment,) * (rest // self.increment)
+        return compute_increments(nb_classes, self.num_bases, self.increment)
 
     def normalization_stats(self) -> Tuple[Tuple[float, ...], Tuple[float, ...]]:
         """Mean/std used by the input pipeline.

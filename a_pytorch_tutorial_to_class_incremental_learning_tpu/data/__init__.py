@@ -1,0 +1,56 @@
+"""Data layer (L2): datasets, class-incremental scenario, rehearsal memory,
+host loaders and on-device augmentation.
+
+Native replacement for the reference's continuum + timm + DataLoader stack
+(SURVEY.md #15-#21, #24).
+"""
+
+from .datasets import (  # noqa: F401
+    build_raw_dataset,
+    decode_image_batch,
+    load_cifar100,
+    load_image_folder,
+    load_synthetic,
+    maybe_decode,
+)
+from .scenario import ClassIncremental, TaskSet  # noqa: F401
+from .memory import (  # noqa: F401
+    RehearsalMemory,
+    herd_barycenter,
+    herd_cluster,
+    herd_random,
+)
+from .loader import eval_batches, sequential_batches, train_batches  # noqa: F401
+
+
+def build_scenario(config, train: bool):
+    """Dataset flags -> ``(ClassIncremental scenario, nb_classes)``.
+
+    Counterpart of ``build_dataset`` (reference ``utils.py:188-207``): loads
+    the raw arrays and wraps them in the task-splitting scenario with the
+    config's class order.
+    """
+    from ..config import CIFAR100_CLASS_ORDER
+
+    (x, y), nb_classes = build_raw_dataset(
+        config.data_set, config.data_path, train, config.input_size
+    )
+    order = config.class_order
+    if order is not None and len(order) != nb_classes:
+        if tuple(order) != CIFAR100_CLASS_ORDER:
+            # An explicitly-supplied order that doesn't fit the dataset is a
+            # misconfiguration — never silently fall back to identity.
+            raise ValueError(
+                f"class_order has {len(order)} entries but the dataset has "
+                f"{nb_classes} classes"
+            )
+        order = None  # default CIFAR order on a non-100-class dataset
+        # (e.g. synthetic20 smoke runs): identity order
+    scenario = ClassIncremental(
+        x,
+        y,
+        initial_increment=config.num_bases,
+        increment=config.increment,
+        class_order=order,
+    )
+    return scenario, nb_classes

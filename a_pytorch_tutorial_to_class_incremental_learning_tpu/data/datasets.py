@@ -1,0 +1,205 @@
+"""Raw dataset loading: in-memory ``(images uint8 NHWC, labels int64)`` arrays.
+
+The reference delegates dataset IO to ``continuum.datasets`` (CIFAR100
+auto-download, ImageFolder for ImageNet; reference ``utils.py:188-207``).
+TPU-native equivalent: datasets are plain numpy arrays held in host RAM
+(CIFAR-100 is 150 MB — trivially resident), batched on the host and augmented
+*on device* inside the compiled step (see ``data/augment.py``), replacing the
+reference's 10-process CPU DataLoader worker pool (``template.py:236-239``).
+
+Zero-egress environments cannot auto-download, so ``cifar`` requires the
+standard ``cifar-100-python`` pickle directory on disk; the ``synthetic``
+dataset generates a class-separable mixture for tests/benches that must run
+without data.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray]  # (x uint8 [N,H,W,C], y int64 [N])
+
+
+def load_cifar100(data_path: str, train: bool) -> Arrays:
+    """Parse the standard ``cifar-100-python`` pickle distribution.
+
+    Accepts ``data_path`` pointing at the extracted directory, its parent, or
+    the ``.tar.gz`` archive.  Counterpart of ``continuum.datasets.CIFAR100``
+    (reference ``utils.py:192``) minus the network download.
+    """
+    split = "train" if train else "test"
+    candidates = [
+        os.path.join(data_path, "cifar-100-python", split),
+        os.path.join(data_path, split),
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                raw = pickle.load(f, encoding="bytes")
+            return _decode_cifar(raw)
+    for tar in (data_path, os.path.join(data_path, "cifar-100-python.tar.gz")):
+        if os.path.isfile(tar) and tarfile.is_tarfile(tar):
+            with tarfile.open(tar) as tf:
+                member = tf.extractfile(f"cifar-100-python/{split}")
+                assert member is not None
+                raw = pickle.load(member, encoding="bytes")  # noqa: S301
+            return _decode_cifar(raw)
+    raise FileNotFoundError(
+        f"CIFAR-100 not found under {data_path!r} (no auto-download in a "
+        "zero-egress environment); use --data_set synthetic for smoke runs"
+    )
+
+
+def _decode_cifar(raw: dict) -> Arrays:
+    x = np.asarray(raw[b"data"], np.uint8).reshape(-1, 3, 32, 32)
+    x = x.transpose(0, 2, 3, 1)  # NCHW storage -> NHWC (TPU-native layout)
+    y = np.asarray(raw[b"fine_labels"], np.int64)
+    return np.ascontiguousarray(x), y
+
+
+def load_synthetic(
+    nb_classes: int = 100,
+    per_class: int = 64,
+    input_size: int = 32,
+    channels: int = 3,
+    train: bool = True,
+    seed: int = 1234,
+) -> Arrays:
+    """Class-separable synthetic data: per-class template image + pixel noise.
+
+    Deterministic in ``seed`` (train/val draw disjoint noise), learnable well
+    above chance by a small CNN — the dataset used by tests, ``bench.py`` and
+    the multi-chip dry-run, where real data may not exist on disk.
+    """
+    rng = np.random.RandomState(seed)
+    templates = rng.randint(
+        0, 256, size=(nb_classes, input_size, input_size, channels)
+    ).astype(np.float32)
+    noise_rng = np.random.RandomState(seed + (1 if train else 2))
+    y = np.repeat(np.arange(nb_classes, dtype=np.int64), per_class)
+    noise = noise_rng.normal(0.0, 48.0, size=(len(y), input_size, input_size, channels))
+    x = np.clip(templates[y] + noise, 0, 255).astype(np.uint8)
+    perm = np.random.RandomState(seed + 3).permutation(len(y))
+    return x[perm], y[perm]
+
+
+def load_image_folder(data_path: str, train: bool) -> Arrays:
+    """ImageNet-style ``train/``/``val/`` class-folder tree, loaded **lazily**.
+
+    Counterpart of the reference's ``ImageNet1000`` (``utils.py:171-185``).
+    Like continuum's ``ImageFolderDataset``, the in-memory representation is
+    the array of file *paths* (object dtype) — raw samples, rehearsal
+    exemplars and task slices are all path arrays; pixels are decoded
+    per batch by :func:`decode_image_batch` (host) and augmented on device.
+    This keeps 1.28M-image splits at a few hundred MB of RAM instead of
+    hundreds of GB.
+    """
+    root = os.path.join(data_path, "train" if train else "val")
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"image-folder split not found: {root}")
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    paths, ys = [], []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fname in sorted(os.listdir(cdir)):
+            paths.append(os.path.join(cdir, fname))
+            ys.append(label)
+    return np.asarray(paths, object), np.asarray(ys, np.int64)
+
+
+def _random_resized_crop(im, input_size: int, rng: np.random.RandomState):
+    """torchvision ``RandomResizedCrop(input_size)``: area scale (0.08, 1.0),
+    aspect ratio (3/4, 4/3), 10 attempts then center-crop fallback — the first
+    transform of timm's train pipeline, kept for >32px inputs
+    (reference ``utils.py:217-229``).  Host-side, like the reference's."""
+    from PIL import Image
+
+    w, h = im.size
+    area = w * h
+    for _ in range(10):
+        target = area * rng.uniform(0.08, 1.0)
+        ar = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        cw = int(round(np.sqrt(target * ar)))
+        ch = int(round(np.sqrt(target / ar)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x0 = rng.randint(0, w - cw + 1)
+            y0 = rng.randint(0, h - ch + 1)
+            box = (x0, y0, x0 + cw, y0 + ch)
+            return im.resize((input_size, input_size), Image.BICUBIC, box=box)
+    side = min(w, h)
+    x0, y0 = (w - side) // 2, (h - side) // 2
+    return im.resize(
+        (input_size, input_size), Image.BICUBIC, box=(x0, y0, x0 + side, y0 + side)
+    )
+
+
+def decode_image_batch(
+    paths: np.ndarray, input_size: int, train: bool, seed: int = 0
+) -> np.ndarray:
+    """Decode a batch of image paths to ``uint8 [B, S, S, 3]``.
+
+    Train: RandomResizedCrop (scale 0.08-1.0).  Eval: resize to
+    ``256/224 * input_size`` shorter side + center crop (reference
+    ``utils.py:237-242``).  Decoding fans out over a thread pool (PIL releases
+    the GIL) — the replacement for the DataLoader worker processes.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from PIL import Image
+
+    def one(i: int) -> np.ndarray:
+        with Image.open(paths[i]) as im:
+            im = im.convert("RGB")
+            if train:
+                rng = np.random.RandomState((seed + i) & 0x7FFFFFFF)
+                im = _random_resized_crop(im, input_size, rng)
+            else:
+                resize = int((256 / 224) * input_size)
+                wd, ht = im.size
+                scale = resize / min(wd, ht)
+                im = im.resize(
+                    (max(1, round(wd * scale)), max(1, round(ht * scale))),
+                    Image.BICUBIC,
+                )
+                left = (im.size[0] - input_size) // 2
+                top = (im.size[1] - input_size) // 2
+                im = im.crop((left, top, left + input_size, top + input_size))
+            return np.asarray(im, np.uint8)
+
+    with ThreadPoolExecutor(max_workers=min(16, len(paths))) as pool:
+        return np.stack(list(pool.map(one, range(len(paths)))))
+
+
+def maybe_decode(x: np.ndarray, input_size: int, train: bool, seed: int = 0) -> np.ndarray:
+    """Pass through pixel batches; decode path batches (lazy datasets)."""
+    if x.dtype == np.uint8:
+        return x
+    return decode_image_batch(x, input_size, train, seed)
+
+
+def build_raw_dataset(
+    data_set: str, data_path: str, train: bool, input_size: int = 32
+) -> Tuple[Arrays, int]:
+    """Flag-string dispatch (reference ``build_dataset``, ``utils.py:188-196``).
+
+    Returns ``((x, y), nb_classes)``.
+    """
+    name = data_set.lower()
+    if name == "cifar":
+        x, y = load_cifar100(data_path, train)
+    elif name == "synthetic":
+        x, y = load_synthetic(train=train)
+    elif name.startswith("synthetic"):  # e.g. synthetic20 for smoke runs
+        x, y = load_synthetic(nb_classes=int(name[len("synthetic"):]), train=train)
+    elif name == "imagenet1000":
+        x, y = load_image_folder(data_path, train)
+    else:
+        raise ValueError(f"Unknown dataset {data_set}.")
+    return (x, y), int(y.max()) + 1

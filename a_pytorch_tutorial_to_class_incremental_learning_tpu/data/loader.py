@@ -1,0 +1,96 @@
+"""Host-side input pipeline: deterministic shuffling, sharding, fixed batches.
+
+Replaces ``DistributedSampler`` + the DataLoader worker pool (SURVEY.md #24;
+reference ``template.py:232-239``).  Host work is only index arithmetic and a
+uint8 gather — decode and augmentation happen on device (``data/augment.py``),
+so no worker processes are needed.
+
+Design points:
+
+* **Static shapes.** Every batch has exactly ``batch_size`` rows so the jitted
+  step compiles once.  Train epochs wrap-around-pad the permuted index list
+  (the same duplication ``DistributedSampler`` uses to equalize ranks); eval
+  pads the tail batch and marks padding with zero sample-weights, so eval
+  metrics are *exact* — a conscious fix of the reference's padded-sample
+  double counting (SURVEY.md §7 "remaining hard parts").
+* **Process sharding.** Each host takes a contiguous stripe of every batch
+  (``batch[i*per_proc:(i+1)*per_proc]``), matching the device order of a
+  process-major mesh; per-epoch reshuffling is seeded like
+  ``sampler.set_epoch`` (reference ``template.py:253``) but from the threaded
+  PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from .scenario import TaskSet
+
+
+def _epoch_perm(seed: int, n: int) -> np.ndarray:
+    return np.random.RandomState(seed & 0x7FFFFFFF).permutation(n)
+
+
+def train_batches(
+    task: TaskSet,
+    batch_size: int,
+    seed: int,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Shuffled fixed-shape ``(x uint8, y)`` batches for one epoch.
+
+    ``batch_size`` is the **global** batch; this process yields its
+    ``batch_size // process_count`` stripe of every batch.
+    """
+    n = len(task)
+    perm = _epoch_perm(seed, n)
+    nb_batches = max(1, -(-n // batch_size))  # ceil; wrap-pad the tail
+    padded = np.resize(perm, nb_batches * batch_size)
+    per_proc = batch_size // process_count
+    assert per_proc * process_count == batch_size, (
+        f"batch_size {batch_size} not divisible by process_count {process_count}"
+    )
+    for b in range(nb_batches):
+        idx = padded[b * batch_size : (b + 1) * batch_size]
+        idx = idx[process_index * per_proc : (process_index + 1) * per_proc]
+        yield task.x[idx], task.y[idx]
+
+
+def eval_batches(
+    task: TaskSet,
+    batch_size: int,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Sequential ``(x, y, weight)`` batches; padding rows carry weight 0."""
+    n = len(task)
+    per_proc = batch_size // process_count
+    assert per_proc * process_count == batch_size
+    nb_batches = -(-n // batch_size)
+    for b in range(nb_batches):
+        idx = np.arange(b * batch_size, (b + 1) * batch_size)
+        w = (idx < n).astype(np.float32)
+        idx = np.minimum(idx, n - 1)
+        sl = slice(process_index * per_proc, (process_index + 1) * per_proc)
+        yield task.x[idx[sl]], task.y[idx[sl]], w[sl]
+
+
+def sequential_batches(
+    task: TaskSet, batch_size: int
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Unshuffled, unsharded full pass — the herding feature loader.
+
+    The reference deliberately runs herding feature extraction unsharded so
+    every rank sees identical features and memories stay in sync without
+    communication (``template.py:292-293``); same here, on every process.
+    Tail batch is wrap-padded (callers slice the result to ``len(task)``).
+    """
+    n = len(task)
+    nb_batches = -(-n // batch_size)
+    idx_all = np.resize(np.arange(n), nb_batches * batch_size)
+    for b in range(nb_batches):
+        idx = idx_all[b * batch_size : (b + 1) * batch_size]
+        yield task.x[idx], task.y[idx]

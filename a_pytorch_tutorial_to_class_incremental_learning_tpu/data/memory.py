@@ -1,0 +1,158 @@
+"""Rehearsal memory with herding exemplar selection.
+
+Native replacement for ``continuum.rehearsal.RehearsalMemory``
+(SURVEY.md #20; reference ``template.py:9,212-216,231,300-302``):
+a budgeted exemplar store whose per-class quota shrinks as classes accumulate,
+with iCaRL "barycenter" greedy herding as the default ranking
+(the reference README derives the greedy at ``README.md:134-136``).
+
+Semantics:
+
+* ``add(x, y, t, features)`` ranks each **new** class's samples by the
+  herding method on the given feature vectors (computed by the current
+  post-weight-align model, reference ``template.py:292-302``) and stores them
+  in rank order.  Classes already in memory keep their existing ranking
+  (re-adding injected old exemplars is a no-op) — truncation to the new
+  quota keeps the best-ranked prefix, which is exactly iCaRL's shrinking
+  exemplar-set rule.
+* ``fixed_memory=False`` (reference default): quota = memory_size //
+  nb_seen_classes.  ``True``: memory_size // total_classes fixed slots.
+* ``get()`` returns concatenated ``(x, y, t)`` over all stored classes, ready
+  for ``TaskSet.add_samples`` (reference ``template.py:230-231``).
+
+Selection runs on the host in numpy: it is a once-per-task O(n·m·d) pass over
+at most a few thousand feature vectors — not worth a device round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def herd_barycenter(features: np.ndarray, nb: int) -> np.ndarray:
+    """iCaRL greedy herding: rank samples so each prefix's feature mean best
+    approximates the true class mean (reference ``README.md:134-136``).
+
+    Returns the first ``nb`` selected indices, in selection order.
+    """
+    n = len(features)
+    nb = min(nb, n)
+    mu = features.mean(axis=0)
+    selected = np.zeros(n, bool)
+    order = np.empty(nb, np.int64)
+    running_sum = np.zeros_like(mu)
+    for k in range(nb):
+        # candidate mean if sample i joins: (running_sum + z_i) / (k+1)
+        cand = (running_sum[None, :] + features) / (k + 1)
+        dist = np.linalg.norm(mu[None, :] - cand, axis=1)
+        dist[selected] = np.inf
+        i = int(np.argmin(dist))
+        order[k] = i
+        selected[i] = True
+        running_sum += features[i]
+    return order
+
+
+def herd_random(features: np.ndarray, nb: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    return rng.permutation(len(features))[: min(nb, len(features))]
+
+
+def herd_cluster(features: np.ndarray, nb: int, iters: int = 20) -> np.ndarray:
+    """K-means the class features into ``nb`` clusters, keep the sample nearest
+    each centroid (continuum's "cluster" method)."""
+    n = len(features)
+    nb = min(nb, n)
+    rng = np.random.RandomState(0)
+    centroids = features[rng.permutation(n)[:nb]].copy()
+    for _ in range(iters):
+        d = np.linalg.norm(features[:, None, :] - centroids[None, :, :], axis=2)
+        assign = d.argmin(axis=1)
+        for c in range(nb):
+            members = features[assign == c]
+            if len(members):
+                centroids[c] = members.mean(axis=0)
+    d = np.linalg.norm(features[:, None, :] - centroids[None, :, :], axis=2)
+    chosen: list[int] = []
+    for c in range(nb):
+        for i in np.argsort(d[:, c]):
+            if i not in chosen:
+                chosen.append(int(i))
+                break
+    return np.asarray(chosen, np.int64)
+
+
+_METHODS: Dict[str, Callable[..., np.ndarray]] = {
+    "barycenter": herd_barycenter,
+    "random": herd_random,
+    "cluster": herd_cluster,
+}
+
+
+class RehearsalMemory:
+    """Budgeted exemplar store (see module docstring)."""
+
+    def __init__(
+        self,
+        memory_size: int = 2000,
+        herding_method="barycenter",
+        fixed_memory: bool = False,
+        nb_total_classes: Optional[int] = None,
+    ):
+        if isinstance(herding_method, str):
+            if herding_method not in _METHODS:
+                raise ValueError(
+                    f"unknown herding_method {herding_method!r}; "
+                    f"options: {sorted(_METHODS)} or a callable"
+                )
+            herding_method = _METHODS[herding_method]
+        self.herd = herding_method
+        self.memory_size = memory_size
+        self.fixed_memory = fixed_memory
+        if fixed_memory and not nb_total_classes:
+            raise ValueError("fixed_memory=True requires nb_total_classes")
+        self.nb_total_classes = nb_total_classes
+        # class -> (x, y, t) in herding-rank order
+        self._store: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+
+    @property
+    def nb_classes(self) -> int:
+        return len(self._store)
+
+    def __len__(self) -> int:
+        return sum(len(v[1]) for v in self._store.values())
+
+    def quota(self, nb_seen_classes: int) -> int:
+        if self.fixed_memory:
+            return self.memory_size // int(self.nb_total_classes)
+        return self.memory_size // max(nb_seen_classes, 1)
+
+    def add(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        t: Optional[np.ndarray],
+        features: np.ndarray,
+    ) -> None:
+        y = np.asarray(y)
+        if t is None:
+            t = np.full(len(y), -1, np.int64)
+        new_classes = [c for c in np.unique(y) if int(c) not in self._store]
+        q = self.quota(len(self._store) + len(new_classes))
+        for c in new_classes:
+            idx = np.where(y == c)[0]
+            rank = self.herd(np.asarray(features)[idx], q)
+            keep = idx[rank]
+            self._store[int(c)] = (x[keep].copy(), y[keep].copy(), np.asarray(t)[keep].copy())
+        # Shrink every class to the (possibly reduced) quota; rank order makes
+        # truncation keep the best exemplars.
+        for c, (cx, cy, ct) in list(self._store.items()):
+            self._store[c] = (cx[:q], cy[:q], ct[:q])
+
+    def get(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if not self._store:
+            raise ValueError("memory is empty")
+        xs, ys, ts = zip(*(self._store[c] for c in sorted(self._store)))
+        return np.concatenate(xs), np.concatenate(ys), np.concatenate(ts)

@@ -1,0 +1,195 @@
+"""Augmentation tests: PIL parity for color/histogram ops, pipeline shape /
+range / determinism (SURVEY.md §4; reference pipeline utils.py:210-251)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+    AugmentConfig,
+    _autocontrast,
+    _brightness,
+    _color,
+    _contrast,
+    _equalize,
+    _invert,
+    _posterize,
+    _random_crop,
+    _rotate,
+    _round_u8,
+    _sharpness,
+    _solarize,
+    _solarize_add,
+    _translate_x,
+    eval_preprocess,
+    train_augment,
+)
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _img(seed=0, size=16):
+    return np.random.RandomState(seed).randint(
+        0, 256, (size, size, 3)
+    ).astype(np.float32)
+
+
+def _pil(img):
+    from PIL import Image
+
+    return Image.fromarray(img.astype(np.uint8))
+
+
+# --------------------------------------------------------------------------- #
+# PIL parity of uint8-domain ops (the ones with exact integer semantics)
+# --------------------------------------------------------------------------- #
+
+
+def test_invert_solarize_posterize_pil_parity():
+    from PIL import ImageOps
+
+    img = _img(1)
+    np.testing.assert_array_equal(
+        np.asarray(_round_u8(_invert(jnp.asarray(img), None))),
+        np.asarray(ImageOps.invert(_pil(img)), np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(_round_u8(_solarize(jnp.asarray(img), 26.0))),
+        np.asarray(ImageOps.solarize(_pil(img), 26), np.float32),
+    )
+    for bits in (1, 2, 3, 4):
+        np.testing.assert_array_equal(
+            np.asarray(_round_u8(_posterize(jnp.asarray(img), float(bits)))),
+            np.asarray(ImageOps.posterize(_pil(img), bits), np.float32),
+        )
+
+
+def test_solarize_add_timm_parity():
+    # timm's solarize_add: img + add where img < 128, clipped to 255.
+    img = _img(2)
+    out = np.asarray(_round_u8(_solarize_add(jnp.asarray(img), 99.0)))
+    ref = img.copy()
+    lut = ref < 128
+    ref[lut] = np.minimum(ref[lut] + 99, 255)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_equalize_pil_parity():
+    from PIL import ImageOps
+
+    for seed in range(3):
+        img = _img(seed)
+        out = np.asarray(_round_u8(_equalize(jnp.asarray(img), None)))
+        ref = np.asarray(ImageOps.equalize(_pil(img)), np.float32)
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_autocontrast_pil_parity():
+    from PIL import ImageOps
+
+    # Low-dynamic-range image so autocontrast actually stretches.
+    img = np.clip(_img(3) * 0.4 + 60, 0, 255)
+    out = np.asarray(_round_u8(_autocontrast(jnp.asarray(np.round(img)), None)))
+    ref = np.asarray(ImageOps.autocontrast(_pil(np.round(img))), np.float32)
+    assert np.abs(out - ref).max() <= 1.0  # PIL LUT rounds via int table
+
+
+@pytest.mark.parametrize(
+    "enhance_name,fn",
+    [("Color", _color), ("Contrast", _contrast), ("Brightness", _brightness),
+     ("Sharpness", _sharpness)],
+)
+def test_enhance_ops_pil_parity(enhance_name, fn):
+    from PIL import ImageEnhance
+
+    img = _img(4)
+    for factor in (0.1, 0.7, 1.3, 1.9):
+        out = np.asarray(_round_u8(fn(jnp.asarray(img), jnp.float32(factor))))
+        ref = np.asarray(
+            getattr(ImageEnhance, enhance_name)(_pil(img)).enhance(factor),
+            np.float32,
+        )
+        # PIL blends in integer space with slightly different rounding; allow
+        # off-by-one per pixel.
+        assert np.abs(out - ref).max() <= 1.0, f"{enhance_name}@{factor}"
+
+
+# --------------------------------------------------------------------------- #
+# Geometric ops: golden properties
+# --------------------------------------------------------------------------- #
+
+
+def test_rotate_identity_and_quarter():
+    img = jnp.asarray(_img(5))
+    np.testing.assert_allclose(
+        np.asarray(_rotate(img, jnp.float32(0.0))), np.asarray(img), atol=1e-3
+    )
+    # 90-degree rotation hits exact grid points -> must equal np.rot90.
+    out90 = np.asarray(_rotate(img, jnp.float32(90.0)))
+    ref = np.asarray(img)
+    assert (
+        np.abs(out90 - np.rot90(ref, k=1)).max() < 1e-2
+        or np.abs(out90 - np.rot90(ref, k=-1)).max() < 1e-2
+    )
+
+
+def test_translate_moves_content():
+    img = jnp.asarray(_img(6))
+    # output->input map with +3: out[x] = in[x+3], content shifts left.
+    out = np.asarray(_translate_x(img, jnp.float32(3.0)))
+    np.testing.assert_allclose(out[:, :-3], np.asarray(img)[:, 3:], atol=1e-3)
+    assert np.all(out[:, -3:] == 128.0)
+
+
+def test_random_crop_within_pad():
+    img = jnp.asarray(_img(7, size=8))
+    out = np.asarray(_random_crop(jax.random.PRNGKey(0), img, 2))
+    assert out.shape == img.shape
+
+
+# --------------------------------------------------------------------------- #
+# Full pipeline
+# --------------------------------------------------------------------------- #
+
+
+def test_train_augment_shapes_range_determinism():
+    cfg = AugmentConfig()
+    batch = np.random.RandomState(0).randint(0, 256, (8, 32, 32, 3), np.uint8)
+    key = jax.random.PRNGKey(42)
+    out1 = np.asarray(train_augment(key, jnp.asarray(batch), cfg))
+    out2 = np.asarray(train_augment(key, jnp.asarray(batch), cfg))
+    out3 = np.asarray(train_augment(jax.random.PRNGKey(7), jnp.asarray(batch), cfg))
+    assert out1.shape == (8, 32, 32, 3) and out1.dtype == np.float32
+    np.testing.assert_array_equal(out1, out2)  # same key -> bit-identical
+    assert not np.array_equal(out1, out3)  # different key -> different augs
+    # Normalized domain: inside roughly (0-mean)/std .. (255-mean)/std.
+    assert out1.min() >= -3.0 and out1.max() <= 3.5
+    # Images within the batch get independent augmentations.
+    same_input = np.repeat(batch[:1], 8, axis=0)
+    outs = np.asarray(train_augment(key, jnp.asarray(same_input), cfg))
+    assert not np.array_equal(outs[0], outs[1])
+
+
+def test_eval_preprocess_exact():
+    cfg = AugmentConfig(mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    batch = np.full((2, 32, 32, 3), 255, np.uint8)
+    out = np.asarray(eval_preprocess(jnp.asarray(batch), cfg))
+    np.testing.assert_allclose(out, (255 - 0.5 * 255) / (0.25 * 255), rtol=1e-6)
+
+
+def test_color_jitter_path_runs():
+    cfg = AugmentConfig(rand_augment=False, color_jitter=0.4)
+    batch = np.random.RandomState(1).randint(0, 256, (4, 32, 32, 3), np.uint8)
+    out = np.asarray(train_augment(jax.random.PRNGKey(0), jnp.asarray(batch), cfg))
+    assert out.shape == (4, 32, 32, 3)
+
+
+def test_random_erasing_path():
+    cfg = AugmentConfig(reprob=1.0)
+    batch = np.zeros((4, 32, 32, 3), np.uint8)
+    out = np.asarray(train_augment(jax.random.PRNGKey(3), jnp.asarray(batch), cfg))
+    # With p=1 every image has an erased noise rectangle -> nonzero variance
+    # beyond the constant normalization value.
+    per_img_std = out.reshape(4, -1).std(axis=1)
+    assert np.all(per_img_std > 0)
