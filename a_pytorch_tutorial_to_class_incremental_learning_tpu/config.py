@@ -100,6 +100,9 @@ class CilConfig:
     herding_method: str = "barycenter"
     memory_size: int = 2000
     fixed_memory: bool = False
+    herding_augmented: bool = True  # the reference extracts herding features
+    # from the *train-transformed* (randomly augmented) dataset
+    # (template.py:292-299); False uses clean eval preprocessing instead.
 
     # Knowledge distillation
     lambda_kd: float = 0.5

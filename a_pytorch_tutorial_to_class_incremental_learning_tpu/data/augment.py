@@ -58,6 +58,7 @@ class AugmentConfig:
     ra_prob: float = 0.5  # per-op apply probability (timm AugmentOp default)
     color_jitter: float = 0.4  # used only when rand_augment is False
     reprob: float = 0.0
+    remode: str = "pixel"  # timm modes: pixel | rand | const
     recount: int = 1
     mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)
     std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
@@ -79,6 +80,7 @@ class AugmentConfig:
             ra_prob=ra["p"] if ra else 0.5,
             color_jitter=config.color_jitter or 0.0,
             reprob=config.reprob,
+            remode=config.remode,
             recount=config.recount,
             mean=tuple(mean),
             std=tuple(std),
@@ -399,8 +401,11 @@ def _color_jitter(key: jax.Array, img: jax.Array, strength: float) -> jax.Array:
 
 
 def _random_erasing(key: jax.Array, img: jax.Array, cfg: AugmentConfig) -> jax.Array:
-    """timm RandomErasing, 'pixel' mode: rectangle of per-pixel N(0,1) noise in
-    the *normalized* domain.  Applied after normalization, like timm."""
+    """timm RandomErasing in the *normalized* domain (applied after
+    normalization, like timm): 'pixel' = per-pixel N(0,1) noise, 'rand' =
+    one N(0,1) value per channel for the whole rectangle, 'const' = zeros."""
+    if cfg.remode not in ("pixel", "rand", "const"):
+        raise ValueError(f"unknown random-erasing mode {cfg.remode!r}")
     h, w = img.shape[0], img.shape[1]
     for i in range(cfg.recount):
         kp, karea, kar, ky, kx, knoise, key = jax.random.split(
@@ -419,9 +424,15 @@ def _random_erasing(key: jax.Array, img: jax.Array, cfg: AugmentConfig) -> jax.A
         ys = jnp.arange(h)[:, None]
         xs = jnp.arange(w)[None, :]
         inside = (ys >= oy) & (ys < oy + eh) & (xs >= ox) & (xs < ox + ew)
-        noise = jax.random.normal(knoise, img.shape, img.dtype)
-        img = jnp.where((do & inside)[..., None] if inside.ndim == 2 else inside,
-                        noise, img)
+        if cfg.remode == "pixel":
+            fill = jax.random.normal(knoise, img.shape, img.dtype)
+        elif cfg.remode == "rand":
+            fill = jnp.broadcast_to(
+                jax.random.normal(knoise, (img.shape[-1],), img.dtype), img.shape
+            )
+        else:  # const
+            fill = jnp.zeros_like(img)
+        img = jnp.where((do & inside)[..., None], fill, img)
     return img
 
 

@@ -56,6 +56,8 @@ def herd_barycenter(features: np.ndarray, nb: int) -> np.ndarray:
 
 
 def herd_random(features: np.ndarray, nb: int, seed: int = 0) -> np.ndarray:
+    """Random ranking.  ``seed`` varies per class (RehearsalMemory passes a
+    distinct one) so selections are independent across classes/tasks."""
     rng = np.random.RandomState(seed)
     return rng.permutation(len(features))[: min(nb, len(features))]
 
@@ -143,7 +145,11 @@ class RehearsalMemory:
         q = self.quota(len(self._store) + len(new_classes))
         for c in new_classes:
             idx = np.where(y == c)[0]
-            rank = self.herd(np.asarray(features)[idx], q)
+            if self.herd is herd_random:
+                # Distinct, deterministic stream per class.
+                rank = herd_random(np.asarray(features)[idx], q, seed=int(c) + 1)
+            else:
+                rank = self.herd(np.asarray(features)[idx], q)
             keep = idx[rank]
             self._store[int(c)] = (x[keep].copy(), y[keep].copy(), np.asarray(t)[keep].copy())
         # Shrink every class to the (possibly reduced) quota; rank order makes

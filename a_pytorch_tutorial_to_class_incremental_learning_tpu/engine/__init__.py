@@ -1,0 +1,18 @@
+"""Engine (L5): compiled train/eval steps, SGD, losses, and the WA task loop.
+
+The CIL algorithm layer of the reference (``template.py:191-303``) rebuilt as
+a functional JAX engine (see ``loop.py`` / ``train.py`` docstrings).
+"""
+
+from .losses import accuracy, cross_entropy, soft_target_kd, topk_correct  # noqa: F401
+from .train import (  # noqa: F401
+    Teacher,
+    TrainState,
+    cosine_lr,
+    make_eval_step,
+    make_feature_step,
+    make_train_step,
+    sgd_init,
+    sgd_update,
+)
+from .loop import CilTrainer  # noqa: F401

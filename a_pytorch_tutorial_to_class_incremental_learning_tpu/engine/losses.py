@@ -1,0 +1,97 @@
+"""Losses and accuracy over masked full-width logits.
+
+The reference computes CE + λ·KD over logits whose width physically grows each
+task and slices ``logits[:, :known]`` for distillation
+(reference ``template.py:259-266``, ``utils.py:121-132``).  With the static
+masked head (models/classifier.py), slices become masks driven by the traced
+scalars ``num_active`` / ``known`` — same math, one compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _active_mask(width: int, num_active: jax.Array) -> jax.Array:
+    return jnp.arange(width) < num_active
+
+
+def cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    num_active: jax.Array,
+    label_smoothing: float = 0.0,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean CE with label smoothing over the **active** classes.
+
+    torch ``CrossEntropyLoss(label_smoothing=s)`` semantics (reference
+    ``template.py:219,259``): target = (1-s)·one-hot + s/K uniform, K = number
+    of (active) classes.  Masked columns hold NEG_INF, so ``log_softmax`` over
+    the full width already matches a softmax over the active slice; the
+    smoothing term is summed over active columns only.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    if label_smoothing:
+        mask = _active_mask(logits.shape[-1], num_active)
+        smooth = -jnp.where(mask, logp, 0.0).sum(-1) / num_active.astype(logp.dtype)
+        per = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    else:
+        per = nll
+    if weights is None:
+        return per.mean()
+    return (per * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+
+
+def soft_target_kd(
+    student_logits: jax.Array,
+    teacher_logits: jax.Array,
+    known: jax.Array,
+    temperature: float = 2.0,
+) -> jax.Array:
+    """SoftTarget distillation (reference ``utils.py:121-132``):
+    ``KL(log_softmax(s/T) || softmax(t/T)) * T^2``, batchmean reduction, over
+    the first ``known`` classes (the ``logits[:, :known]`` slice,
+    ``template.py:263``).  Teacher logits are already masked to ``known``.
+    """
+    width = student_logits.shape[-1]
+    mask = _active_mask(width, known)
+    neg = jnp.float32(-1e9)
+    s = jnp.where(mask, student_logits, neg) / temperature
+    t = jnp.where(mask, teacher_logits, neg) / temperature
+    logp_s = jax.nn.log_softmax(s, axis=-1)
+    logp_t = jax.nn.log_softmax(t, axis=-1)
+    p_t = jnp.exp(logp_t)
+    kl_per = jnp.where(mask, p_t * (logp_t - logp_s), 0.0).sum(-1)
+    return kl_per.mean() * temperature * temperature
+
+
+def topk_correct(
+    logits: jax.Array,
+    labels: jax.Array,
+    k: int,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Weighted count of samples whose label is in the top-k masked logits."""
+    _, idx = jax.lax.top_k(logits, k)
+    hit = (idx == labels[:, None]).any(axis=-1).astype(jnp.float32)
+    if weights is None:
+        return hit.sum()
+    return (hit * weights).sum()
+
+
+def accuracy(
+    logits: jax.Array, labels: jax.Array, topk: Tuple[int, ...] = (1, 5)
+) -> Tuple[jax.Array, ...]:
+    """Batch top-k accuracies **in percent** (timm ``utils.accuracy``
+    semantics, SURVEY.md #22; used at reference ``template.py:267-268``).
+    Masked columns are NEG_INF so top-k never selects an inactive class;
+    when fewer than ``k`` classes are active this reduces to top-active,
+    matching the reference's ``min(5, nb_logits)`` guard.
+    """
+    b = logits.shape[0]
+    return tuple(topk_correct(logits, labels, k) * (100.0 / b) for k in topk)

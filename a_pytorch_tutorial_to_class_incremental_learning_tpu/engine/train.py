@@ -1,0 +1,214 @@
+"""Compiled train/eval/feature steps and the torch-parity SGD.
+
+The reference's hot loop (``template.py:251-280``) is: augmented batch ->
+forward -> CE + λ·KD -> backward -> SGD step -> explicit NCCL barrier.
+TPU-native, the whole thing — *including augmentation* — is one jitted SPMD
+program over the device mesh: XLA overlaps the gradient all-reduce with
+backward compute, and there are no barriers (SURVEY.md §5 "distributed
+communication backend").  The KD teacher forward runs inside the same
+program, so the two forwards the reference pays serially get scheduled
+together.
+
+Step functions are built once per task-phase (with/without teacher) and cached
+by shape-stable closure — ``num_active``/``known`` are traced scalars, so the
+same executable serves every task (SURVEY.md §7 hard-part 1, option b).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..data.augment import AugmentConfig, eval_preprocess, train_augment
+from .losses import accuracy, cross_entropy, soft_target_kd, topk_correct
+
+
+@struct.dataclass
+class TrainState:
+    """All mutable training state as one pytree (donated through the step)."""
+
+    params: Any
+    batch_stats: Any
+    momentum: Any  # SGD velocity, reset per task (reference template.py:246)
+    num_active: jax.Array  # classes live in the head (traced -> no recompile)
+    known: jax.Array  # classes seen before the current task
+
+
+@struct.dataclass
+class Teacher:
+    """Frozen previous-task model (the reference's ``copy().freeze()``,
+    ``template.py:290``); runs in eval mode inside the student's step."""
+
+    params: Any
+    batch_stats: Any
+    known: jax.Array
+
+
+# --------------------------------------------------------------------------- #
+# SGD with exact torch semantics (reference template.py:246-247)
+# --------------------------------------------------------------------------- #
+
+
+def sgd_init(params: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def sgd_update(
+    params: Any,
+    grads: Any,
+    momentum_buf: Any,
+    lr: jax.Array,
+    momentum: float,
+    weight_decay: float,
+) -> Tuple[Any, Any]:
+    """torch.optim.SGD: g += wd·p;  buf = m·buf + g;  p -= lr·buf.
+
+    Weight decay hits every parameter (the reference passes all of
+    ``model.parameters()``), dampening 0, no Nesterov.
+    """
+
+    new_buf = jax.tree_util.tree_map(
+        lambda p, g, b: momentum * b + g + weight_decay * p,
+        params,
+        grads,
+        momentum_buf,
+    )
+    new_params = jax.tree_util.tree_map(lambda p, b: p - lr * b, params, new_buf)
+    return new_params, new_buf
+
+
+def cosine_lr(base_lr: float, epoch: int, num_epochs: int) -> float:
+    """torch ``CosineAnnealingLR(T_max=num_epochs)`` stepped per epoch
+    (reference ``template.py:248-249,278``)."""
+    import math
+
+    return base_lr * 0.5 * (1.0 + math.cos(math.pi * epoch / num_epochs))
+
+
+# --------------------------------------------------------------------------- #
+# Step builders
+# --------------------------------------------------------------------------- #
+
+
+def make_train_step(
+    model,
+    aug_cfg: AugmentConfig,
+    label_smoothing: float,
+    kd_temperature: float,
+    momentum: float,
+    weight_decay: float,
+    has_teacher: bool,
+):
+    """Build the jitted train step.
+
+    Two variants exist per run (task 0 has no teacher); each compiles once.
+    Returns ``step(state, teacher, x_u8, labels, key, lr, lambda_kd) ->
+    (state, metrics dict)`` with metrics as device scalars (no host sync in
+    the loop — the reference barriers every step, ``template.py:272``; here
+    synchronization happens implicitly at epoch-boundary logging).
+    ``lr`` and ``lambda_kd`` are traced scalars: the cosine schedule and the
+    (optionally dynamic) KD weight change without recompilation.
+    """
+
+    def step(
+        state: TrainState,
+        teacher: Optional[Teacher],
+        x_u8: jax.Array,
+        labels: jax.Array,
+        key: jax.Array,
+        lr: jax.Array,
+        lambda_kd: jax.Array,
+    ):
+        x = train_augment(key, x_u8, aug_cfg)
+
+        def loss_fn(params):
+            (logits, _feats), mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                x,
+                num_active=state.num_active,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            ce = cross_entropy(logits, labels, state.num_active, label_smoothing)
+            if has_teacher:
+                t_logits, _ = model.apply(
+                    {"params": teacher.params, "batch_stats": teacher.batch_stats},
+                    x,
+                    num_active=teacher.known,
+                    train=False,
+                )
+                kd = lambda_kd * soft_target_kd(
+                    logits, t_logits, state.known, kd_temperature
+                )
+            else:
+                kd = jnp.float32(0.0)
+            return ce + kd, (mutated["batch_stats"], logits, ce, kd)
+
+        grads, (new_stats, logits, ce, kd) = jax.grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        new_params, new_buf = sgd_update(
+            state.params, grads, state.momentum, lr, momentum, weight_decay
+        )
+        acc1, acc5 = accuracy(logits, labels, topk=(1, 5))
+        new_state = state.replace(
+            params=new_params, batch_stats=new_stats, momentum=new_buf
+        )
+        metrics = {"ce": ce, "kd": kd, "loss": ce + kd, "acc1": acc1, "acc5": acc5}
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_eval_step(model, aug_cfg: AugmentConfig):
+    """Weighted eval statistics for one batch (padding rows weigh 0).
+
+    Returns device sums ``(loss_sum, correct1, correct5, weight_sum)`` —
+    exact-count accounting instead of the reference's padded-sample double
+    counting (SURVEY.md §7).
+    """
+
+    def step(params, batch_stats, x_u8, labels, weights, num_active):
+        x = eval_preprocess(x_u8, aug_cfg)
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x,
+            num_active=num_active,
+            train=False,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        loss_sum = (nll * weights).sum()
+        c1 = topk_correct(logits, labels, 1, weights)
+        c5 = topk_correct(logits, labels, 5, weights)
+        return loss_sum, c1, c5, weights.sum()
+
+    return jax.jit(step)
+
+
+def make_feature_step(model, aug_cfg: AugmentConfig, augmented: bool):
+    """Herding feature extraction (reference ``template.py:292-299``).
+
+    ``augmented=True`` reproduces the reference exactly: its herding loader
+    wraps the *train* dataset, so features come from randomly augmented
+    images; ``False`` uses clean eval preprocessing (arguably better
+    exemplars — kept behind ``CilConfig.herding_augmented``).
+    """
+
+    def step(params, batch_stats, x_u8, key):
+        if augmented:
+            x = train_augment(key, x_u8, aug_cfg)
+        else:
+            x = eval_preprocess(x_u8, aug_cfg)
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x,
+            train=False,
+            method=model.extract_vector,
+        )
+
+    return jax.jit(step)

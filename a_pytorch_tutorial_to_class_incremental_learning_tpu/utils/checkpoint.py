@@ -1,0 +1,115 @@
+"""Per-task checkpoint/resume.
+
+The reference never persists anything — a crash in task 7 of 10 loses the run
+(SURVEY.md §5 "checkpoint/resume: absent"); on TPU pods preemption makes this
+mandatory.  Granularity is the task boundary: after task t finishes (post
+weight-align, post herding) we persist everything ``fit()`` needs to continue
+at task t+1 — params, batch stats, rehearsal memory, accuracy history, class
+bookkeeping.  Momentum is *not* saved because the reference re-initializes the
+optimizer every task anyway (``template.py:246``), so task-boundary resume is
+exact: a killed-and-resumed run reproduces the uninterrupted run bit-for-bit
+(same PRNG folds, same shuffles, same memory).
+
+Format: one pickle per task of host numpy pytrees (atomic rename), written by
+process 0 only.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.dist import barrier, is_main_process
+
+
+def _task_path(ckpt_dir: str, task_id: int) -> str:
+    return os.path.join(ckpt_dir, f"task_{task_id:03d}.ckpt")
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)), tree)
+
+
+def save_task_checkpoint(trainer, task_id: int) -> str:
+    """Persist post-task state (called by ``CilTrainer.fit`` when
+    ``ckpt_dir`` is set)."""
+    ckpt_dir = trainer.config.ckpt_dir
+    path = _task_path(ckpt_dir, task_id)
+    if is_main_process():
+        os.makedirs(ckpt_dir, exist_ok=True)
+        payload = {
+            "task_id": task_id,
+            "known": trainer.known,  # already includes this task's classes
+            "acc1s": list(trainer.acc1s),
+            "params": _to_host(trainer.state.params),
+            "batch_stats": _to_host(trainer.state.batch_stats),
+            "memory_store": trainer.memory._store,
+            "config_seed": trainer.config.seed,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    barrier()
+    return path
+
+
+def latest_task_checkpoint(ckpt_dir: str) -> Optional[str]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = None
+    for name in os.listdir(ckpt_dir):
+        m = re.fullmatch(r"task_(\d+)\.ckpt", name)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), os.path.join(ckpt_dir, name))
+    return best[1] if best else None
+
+
+def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
+    """Restore a trainer to the state right after the checkpointed task.
+
+    Returns True when a checkpoint was found and loaded; ``trainer.fit()``
+    then skips tasks ``<= task_id`` via ``start_task``.
+    """
+    from ..engine.train import Teacher, sgd_init
+    from ..parallel.mesh import shard_params
+
+    path = path or latest_task_checkpoint(trainer.config.ckpt_dir or "")
+    if not path or not os.path.exists(path):
+        return False
+    with open(path, "rb") as f:
+        payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint
+    if payload["config_seed"] != trainer.config.seed:
+        raise ValueError(
+            f"checkpoint seed {payload['config_seed']} != config seed "
+            f"{trainer.config.seed}; refusing silent mix of experiments"
+        )
+    params = shard_params(trainer.mesh, payload["params"])
+    batch_stats = shard_params(trainer.mesh, payload["batch_stats"])
+    known = int(payload["known"])
+    trainer.state = trainer.state.replace(
+        params=params,
+        batch_stats=batch_stats,
+        momentum=sgd_init(params),
+        num_active=jnp.int32(known),
+        known=jnp.int32(known),
+    )
+    # The post-task model *is* the teacher for the next task
+    # (reference template.py:290).
+    trainer.teacher = Teacher(
+        params=jax.tree_util.tree_map(jnp.copy, params),
+        batch_stats=jax.tree_util.tree_map(jnp.copy, batch_stats),
+        known=jnp.int32(known),
+    )
+    trainer.known = known
+    trainer.acc1s = list(payload["acc1s"])
+    trainer.memory._store = payload["memory_store"]
+    trainer.start_task = payload["task_id"] + 1
+    print(f"| resumed from {path}: next task {trainer.start_task}, known={known}")
+    return True

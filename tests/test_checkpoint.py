@@ -1,0 +1,85 @@
+"""Checkpoint/resume: a killed-and-resumed run reproduces the uninterrupted
+run exactly (SURVEY.md §5 gap; VERDICT item 10)."""
+
+import os
+
+import numpy as np
+import jax
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import CilTrainer
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import make_mesh
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.checkpoint import (
+    latest_task_checkpoint,
+)
+
+
+def _cfg(**kw):
+    defaults = dict(
+        data_set="synthetic10",
+        num_bases=0,
+        increment=5,
+        backbone="resnet20",
+        batch_size=8,
+        num_epochs=2,
+        eval_every_epoch=100,
+        memory_size=40,
+        lr=0.05,
+        aa=None,
+        color_jitter=0.0,
+        seed=11,
+    )
+    defaults.update(kw)
+    return CilConfig(**defaults)
+
+
+def test_kill_and_resume_reproduces(devices8, tmp_path):
+    mesh = make_mesh((8, 1))
+    ckpt = str(tmp_path / "ckpts")
+
+    # Uninterrupted 2-task run (also writes per-task checkpoints).
+    full = CilTrainer(_cfg(ckpt_dir=ckpt), mesh=mesh, init_dist=False)
+    ref = full.fit()
+    assert latest_task_checkpoint(ckpt).endswith("task_001.ckpt")
+
+    # Simulate a crash after task 0: drop the task-1 checkpoint and resume.
+    os.remove(os.path.join(ckpt, "task_001.ckpt"))
+    resumed = CilTrainer(
+        _cfg(ckpt_dir=ckpt, resume=True), mesh=mesh, init_dist=False
+    )
+    assert resumed.start_task == 1
+    assert resumed.known == 5
+    assert resumed.memory.nb_classes == 5
+    assert resumed.teacher is not None
+    out = resumed.fit()
+
+    # Task-boundary resume is exact: same PRNG folds, same shuffles, same
+    # memory -> bit-identical accuracy history.
+    assert out["acc1s"][0] == ref["acc1s"][0]  # restored, not recomputed
+    assert out["acc1s"][1] == ref["acc1s"][1]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(full.state.params),
+        jax.tree_util.tree_leaves(resumed.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_refuses_seed_mismatch(devices8, tmp_path):
+    import pytest
+
+    mesh = make_mesh((8, 1))
+    ckpt = str(tmp_path / "ckpts")
+    CilTrainer(_cfg(ckpt_dir=ckpt, num_epochs=1), mesh=mesh, init_dist=False).fit()
+    with pytest.raises(ValueError):
+        CilTrainer(
+            _cfg(ckpt_dir=ckpt, resume=True, seed=99), mesh=mesh, init_dist=False
+        )
+
+
+def test_resume_without_checkpoint_is_fresh(devices8, tmp_path):
+    t = CilTrainer(
+        _cfg(ckpt_dir=str(tmp_path / "none"), resume=True),
+        mesh=make_mesh((8, 1)),
+        init_dist=False,
+    )
+    assert t.start_task == 0 and t.known == 0

@@ -1,0 +1,154 @@
+"""Engine tests: loss golden values vs torch/analytic, SGD parity, cosine
+schedule, and step mechanics (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import (
+    accuracy,
+    cosine_lr,
+    cross_entropy,
+    sgd_init,
+    sgd_update,
+    soft_target_kd,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.models.classifier import (
+    NEG_INF,
+)
+
+
+def _masked(logits, active):
+    width = logits.shape[-1]
+    return np.where(np.arange(width) < active, logits, NEG_INF)
+
+
+# --------------------------------------------------------------------------- #
+# KD loss vs torch SoftTarget (reference utils.py:121-132) and analytic KL
+# --------------------------------------------------------------------------- #
+
+
+def test_soft_target_kd_torch_parity():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    known, width = 7, 12
+    s_full = rng.randn(8, width).astype(np.float32) * 3
+    t_full = rng.randn(8, width).astype(np.float32) * 3
+    T = 2.0
+
+    # Reference math on the sliced logits.
+    s_t = torch.from_numpy(s_full[:, :known])
+    t_t = torch.from_numpy(t_full[:, :known])
+    ref = (
+        F.kl_div(
+            F.log_softmax(s_t / T, dim=1),
+            F.softmax(t_t / T, dim=1),
+            reduction="batchmean",
+        )
+        * T
+        * T
+    ).item()
+
+    # Our masked version on full-width logits (teacher masked at `known`).
+    ours = soft_target_kd(
+        jnp.asarray(_masked(s_full, known)),
+        jnp.asarray(_masked(t_full, known)),
+        jnp.int32(known),
+        temperature=T,
+    )
+    assert np.isclose(float(ours), ref, rtol=1e-5)
+
+
+def test_soft_target_kd_analytic():
+    # Two classes, uniform teacher; student = teacher => KL = 0.
+    logits = jnp.asarray(_masked(np.zeros((4, 8), np.float32), 2))
+    assert np.isclose(float(soft_target_kd(logits, logits, jnp.int32(2))), 0.0)
+    # Analytic: s=(log2, 0), t=(0, 0) at T=1: KL = sum p_t (log p_t - log p_s).
+    s = np.array([[np.log(2.0), 0.0]], np.float32)
+    t = np.array([[0.0, 0.0]], np.float32)
+    p_s = np.exp(s[0]) / np.exp(s[0]).sum()
+    expected = float((0.5 * (np.log(0.5) - np.log(p_s))).sum())
+    got = float(
+        soft_target_kd(
+            jnp.asarray(_masked(s, 2)), jnp.asarray(_masked(t, 2)),
+            jnp.int32(2), temperature=1.0,
+        )
+    )
+    assert np.isclose(got, expected, rtol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# CE with label smoothing vs torch (reference template.py:219)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("smooth", [0.0, 0.1])
+def test_cross_entropy_torch_parity(smooth):
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.RandomState(1)
+    active, width = 6, 10
+    logits = rng.randn(16, width).astype(np.float32) * 2
+    labels = rng.randint(0, active, 16)
+    ref = torch.nn.CrossEntropyLoss(label_smoothing=smooth)(
+        torch.from_numpy(logits[:, :active]), torch.from_numpy(labels)
+    ).item()
+    ours = cross_entropy(
+        jnp.asarray(_masked(logits, active)),
+        jnp.asarray(labels),
+        jnp.int32(active),
+        label_smoothing=smooth,
+    )
+    assert np.isclose(float(ours), ref, rtol=1e-5)
+
+
+def test_accuracy_percent_semantics():
+    logits = np.full((4, 8), NEG_INF, np.float32)
+    logits[:, :4] = [[5, 1, 0, 0], [1, 5, 0, 0], [0, 1, 5, 0], [5, 1, 2, 3]]
+    labels = jnp.asarray([0, 1, 0, 2])
+    a1, a5 = accuracy(jnp.asarray(logits), labels, topk=(1, 5))
+    assert float(a1) == 50.0  # 2/4 correct, in percent
+    assert float(a5) == 100.0  # top-5 covers all 4 active classes
+
+
+# --------------------------------------------------------------------------- #
+# SGD vs torch (reference template.py:246-247) and cosine schedule
+# --------------------------------------------------------------------------- #
+
+
+def test_sgd_torch_parity():
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 5e-4
+
+    tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    opt = torch.optim.SGD([tw], lr=lr, momentum=mom, weight_decay=wd)
+    params = {"w": jnp.asarray(w0)}
+    buf = sgd_init(params)
+    for i in range(4):
+        g = rng.randn(5, 3).astype(np.float32)
+        opt.zero_grad()
+        tw.grad = torch.from_numpy(g.copy())
+        opt.step()
+        params, buf = sgd_update(params, {"w": jnp.asarray(g)}, buf, lr, mom, wd)
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), tw.detach().numpy(), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_cosine_lr_torch_parity():
+    torch = pytest.importorskip("torch")
+
+    base, epochs = 0.1, 10
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=base)
+    sched = torch.optim.lr_scheduler.CosineAnnealingLR(opt, T_max=epochs)
+    for epoch in range(epochs):
+        ref_lr = opt.param_groups[0]["lr"]
+        assert np.isclose(cosine_lr(base, epoch, epochs), ref_lr, rtol=1e-6)
+        sched.step()
