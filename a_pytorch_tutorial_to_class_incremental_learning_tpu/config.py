@@ -185,6 +185,14 @@ def get_args_parser() -> argparse.ArgumentParser:
     p.add_argument("--herding_method", default=d.herding_method, type=str)
     p.add_argument("--memory_size", default=d.memory_size, type=int)
     p.add_argument("--fixed_memory", action="store_true", default=False)
+    p.add_argument(
+        "--no_herding_augmented",
+        action="store_false",
+        dest="herding_augmented",
+        default=True,
+        help="extract herding features from clean (eval-preprocessed) images "
+        "instead of the reference's randomly augmented ones",
+    )
     p.add_argument("--lr", default=d.lr, type=float)
     p.add_argument("--momentum", default=d.momentum, type=float)
     p.add_argument("--weight_decay", default=d.weight_decay, type=float)
@@ -237,6 +245,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         herding_method=args.herding_method,
         memory_size=args.memory_size,
         fixed_memory=args.fixed_memory,
+        herding_augmented=args.herding_augmented,
         lambda_kd=args.lambda_kd,
         dynamic_lambda_kd=args.dynamic_lambda_kd,
         data_set=args.data_set,

@@ -37,7 +37,7 @@ from ..data import (
     train_batches,
 )
 from ..data.augment import AugmentConfig
-from ..models import create_model, grow, init_backbone, weight_align
+from ..models import align, create_model, grow, init_backbone
 from ..parallel.dist import init_distributed_mode
 from ..parallel.mesh import batch_sharding, make_mesh, replicated, shard_params
 from ..utils.logging import MetricLogger
@@ -212,15 +212,9 @@ class CilTrainer:
         )
 
     def _align_state(self, state: TrainState, known: int, nb_new: int):
-        fc = {
-            "kernel": state.params["fc_kernel"],
-            "bias": state.params["fc_bias"],
-        }
-        fc, gamma = weight_align(fc, known, nb_new)
-        params = dict(state.params)
-        params["fc_kernel"] = fc["kernel"]
-        params["fc_bias"] = fc["bias"]
-        return state.replace(params=shard_params(self.mesh, params)), float(gamma)
+        variables, gamma = align({"params": state.params}, known, nb_new)
+        params = shard_params(self.mesh, dict(variables["params"]))
+        return state.replace(params=params), gamma
 
     def _lambda_kd(self, task_id: int) -> float:
         """λ for the KD term.  The reference parses ``--dynamic_lambda_kd``
@@ -280,22 +274,27 @@ class CilTrainer:
 
     def evaluate(self, dataset_val) -> float:
         pidx, pcount = jax.process_index(), jax.process_count()
-        sums = np.zeros(4)
+        pending = []
         for xb, yb, wb in eval_batches(
             dataset_val, self.global_batch_size, pidx, pcount
         ):
             xb = self._decode(xb, train=False, seed=0)
             x, y, w = self._put(xb, yb, wb)
-            out = self.eval_step(
-                self.state.params,
-                self.state.batch_stats,
-                x,
-                y,
-                w,
-                self.state.num_active,
+            pending.append(
+                self.eval_step(
+                    self.state.params,
+                    self.state.batch_stats,
+                    x,
+                    y,
+                    w,
+                    self.state.num_active,
+                )
             )
-            sums += np.asarray([float(v) for v in out])
-        loss_sum, c1, c5, n = sums
+        # Floatify once after the loop: batches dispatch back-to-back without
+        # a per-batch device->host round trip.
+        loss_sum, c1, c5, n = np.sum(
+            [[float(v) for v in out] for out in pending], axis=0
+        )
         acc1 = 100.0 * c1 / max(n, 1.0)
         acc5 = 100.0 * c5 / max(n, 1.0)
         print(f" Acc@1 {acc1:.3f}  Acc@5 {acc5:.3f}  loss {loss_sum / max(n, 1.0):.3f}")

@@ -81,7 +81,25 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
     from ..parallel.mesh import shard_params
 
     path = path or latest_task_checkpoint(trainer.config.ckpt_dir or "")
-    if not path or not os.path.exists(path):
+    found_task = -1
+    if path and os.path.exists(path):
+        m = re.search(r"task_(\d+)\.ckpt$", path)
+        found_task = int(m.group(1)) if m else -1
+    # Multi-host: every process must agree on the resume point, or they would
+    # run different programs and deadlock.  Fail loudly on disagreement
+    # (e.g. ckpt_dir on non-shared storage).
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        seen = multihost_utils.process_allgather(
+            np.asarray(found_task, dtype=np.int64)
+        )
+        if len(np.unique(seen)) != 1:
+            raise RuntimeError(
+                f"processes disagree on the latest checkpoint ({seen.tolist()}); "
+                "is ckpt_dir on storage shared by all hosts?"
+            )
+    if found_task < 0:
         return False
     with open(path, "rb") as f:
         payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint

@@ -1,0 +1,128 @@
+"""Single-chip benchmark of the hot loop: the KD train step.
+
+Measures steady-state wall time of the compiled train step (augmentation +
+student forward + teacher forward + backward + SGD, i.e. the tasks>=1 hot
+loop, reference ``template.py:251-280``) for ResNet-32 at per-device batch
+128, and derives images/sec and an MFU estimate.
+
+Baseline derivation (BASELINE.md): the reference runs CIFAR-100 B50-inc10
+(6 tasks x 140 epochs, global batch 512 on 4x RTX 3090) in ~30 min.  Total
+trained images ~= 140 * (25000 + 5 * ~7000) ~= 8.4M, so the reference's
+end-to-end training throughput is ~4700 img/s across 4 GPUs.  ``vs_baseline``
+is ours/theirs on that number — a deliberately conservative comparison:
+per chip, our step includes everything (their 30 min also buys eval/herding,
+but their step excludes augmentation, which runs on CPU workers).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_IMG_PER_SEC = 4700.0  # 4x3090, see module docstring
+
+# ResNet-32 CIFAR forward: ~69.4M MACs = ~138.8M FLOPs per image.  Train step
+# = student fwd + bwd (~3x fwd) + teacher fwd (1x) = ~4x fwd FLOPs.
+FLOPS_PER_IMAGE_STEP = 4 * 138.8e6
+TPU_V5E_PEAK_BF16 = 197e12  # per chip
+
+
+def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32"):
+    """``batch_size`` defaults to 512 — the reference's *global* batch
+    (4 GPUs x 128), which fits comfortably on one v5e chip; a multi-chip mesh
+    would use the per-device 128 of the config instead."""
+    import jax
+    import jax.numpy as jnp
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import (
+        CilTrainer,
+        Teacher,
+    )
+
+    cfg = CilConfig(
+        data_set="synthetic",  # 100 classes; content is irrelevant to timing
+        num_bases=50,
+        increment=10,
+        backbone="resnet32",
+        batch_size=batch_size,
+        compute_dtype=compute_dtype,
+        seed=0,
+    )
+    trainer = CilTrainer(cfg, init_dist=False)
+    # Task-1 shape: 50 known classes, 10 new -> KD step variant.
+    trainer.state = trainer._grow_state(trainer.state, 0, 0, 50)
+    trainer.teacher = Teacher(
+        params=jax.tree_util.tree_map(jnp.copy, trainer.state.params),
+        batch_stats=jax.tree_util.tree_map(jnp.copy, trainer.state.batch_stats),
+        known=jnp.int32(50),
+    )
+    trainer.state = trainer._grow_state(trainer.state, 1, 50, 10)
+
+    rng = np.random.RandomState(0)
+    bs = trainer.global_batch_size
+    x = rng.randint(0, 256, (bs, 32, 32, 3)).astype(np.uint8)
+    y = rng.randint(0, 60, bs).astype(np.int64)
+    xd, yd = trainer._put(x, y)
+    step = trainer._steps[True]
+    key = jax.random.PRNGKey(0)
+
+    # Compile + warmup.
+    t0 = time.time()
+    trainer.state, m = step(trainer.state, trainer.teacher, xd, yd, key, 0.1, 0.5)
+    jax.block_until_ready(trainer.state.params)
+    compile_s = time.time() - t0
+    for _ in range(5):
+        trainer.state, m = step(
+            trainer.state, trainer.teacher, xd, yd, key, 0.1, 0.5
+        )
+    jax.block_until_ready(trainer.state.params)
+
+    t0 = time.time()
+    for _ in range(iters):
+        trainer.state, m = step(
+            trainer.state, trainer.teacher, xd, yd, key, 0.1, 0.5
+        )
+    jax.block_until_ready(trainer.state.params)
+    dt = (time.time() - t0) / iters
+
+    img_s = bs / dt
+    mfu = img_s * FLOPS_PER_IMAGE_STEP / TPU_V5E_PEAK_BF16
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_throughput",
+                "value": round(img_s, 1),
+                "unit": "img/s",
+                "vs_baseline": round(img_s / REFERENCE_IMG_PER_SEC, 3),
+                "step_ms": round(dt * 1e3, 3),
+                "global_batch": bs,
+                "compile_s": round(compile_s, 1),
+                # Estimate only: assumes fwd=2*69.4M MACs, bwd=2x fwd,
+                # teacher=1x fwd, against the 197 TFLOP/s bf16 chip peak
+                # (XLA runs f32 convs through the MXU's bf16 path by
+                # default); convention error is easily +/-2x.
+                "est_mfu": round(mfu, 4),
+                "backend": jax.default_backend(),
+                "devices": jax.device_count(),
+                "compute_dtype": compute_dtype,
+                "loss_finite": bool(np.isfinite(float(m["loss"]))),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch_size", type=int, default=512)
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--compute_dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    a = p.parse_args()
+    main(a.batch_size, a.iters, a.compute_dtype)
