@@ -127,6 +127,9 @@ class CilConfig:
     ckpt_dir: Optional[str] = None
     resume: bool = False
 
+    # Profiling (SURVEY.md §5: absent in the reference; near-free here)
+    profile_dir: Optional[str] = None  # trace each task's first epoch
+
     # ------------------------------------------------------------------ #
 
     @property
@@ -213,6 +216,8 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="model-axis size of the device mesh")
     p.add_argument("--ckpt_dir", default=None, type=str)
     p.add_argument("--resume", action="store_true", default=False)
+    p.add_argument("--profile_dir", default=None, type=str,
+                   help="write a jax.profiler trace of each task's first epoch")
     return p
 
 
@@ -255,4 +260,5 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         compute_dtype=args.compute_dtype,
         ckpt_dir=args.ckpt_dir,
         resume=args.resume,
+        profile_dir=args.profile_dir,
     )

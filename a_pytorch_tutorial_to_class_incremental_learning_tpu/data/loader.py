@@ -26,6 +26,7 @@ from typing import Iterator, Tuple
 
 import numpy as np
 
+from ..utils.native import gather_rows
 from .scenario import TaskSet
 
 
@@ -56,7 +57,7 @@ def train_batches(
     for b in range(nb_batches):
         idx = padded[b * batch_size : (b + 1) * batch_size]
         idx = idx[process_index * per_proc : (process_index + 1) * per_proc]
-        yield task.x[idx], task.y[idx]
+        yield gather_rows(task.x, idx), task.y[idx]
 
 
 def eval_batches(
@@ -75,7 +76,7 @@ def eval_batches(
         w = (idx < n).astype(np.float32)
         idx = np.minimum(idx, n - 1)
         sl = slice(process_index * per_proc, (process_index + 1) * per_proc)
-        yield task.x[idx[sl]], task.y[idx[sl]], w[sl]
+        yield gather_rows(task.x, idx[sl]), task.y[idx[sl]], w[sl]
 
 
 def sequential_batches(
@@ -93,4 +94,4 @@ def sequential_batches(
     idx_all = np.resize(np.arange(n), nb_batches * batch_size)
     for b in range(nb_batches):
         idx = idx_all[b * batch_size : (b + 1) * batch_size]
-        yield task.x[idx], task.y[idx]
+        yield gather_rows(task.x, idx), task.y[idx]

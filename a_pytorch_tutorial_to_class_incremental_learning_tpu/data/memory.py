@@ -31,12 +31,28 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 
-def herd_barycenter(features: np.ndarray, nb: int) -> np.ndarray:
+def herd_barycenter(
+    features: np.ndarray, nb: int, allow_native: bool = True
+) -> np.ndarray:
     """iCaRL greedy herding: rank samples so each prefix's feature mean best
     approximates the true class mean (reference ``README.md:134-136``).
 
-    Returns the first ``nb`` selected indices, in selection order.
+    Returns the first ``nb`` selected indices, in selection order.  Dispatches
+    to the C++ kernel (csrc/cil_host.cpp) when built — the greedy is
+    O(nb*n*d) and this numpy version allocates an [n, d] candidate matrix per
+    selection step; the native path allocates nothing.  Both paths accumulate
+    in float64 over float32 inputs so their selections agree; in multi-process
+    runs the trainer disables the native path fleet-wide unless *every*
+    process has the library (replicated memories must stay bit-identical).
     """
+    if allow_native:
+        from ..utils.native import herd_barycenter_native
+
+        native = herd_barycenter_native(np.asarray(features, np.float32), nb)
+        if native is not None:
+            return native
+    # float32 storage, float64 accumulation — the C++ kernel's arithmetic.
+    features = np.asarray(features, np.float32).astype(np.float64)
     n = len(features)
     nb = min(nb, n)
     mu = features.mean(axis=0)
@@ -102,6 +118,7 @@ class RehearsalMemory:
         herding_method="barycenter",
         fixed_memory: bool = False,
         nb_total_classes: Optional[int] = None,
+        prefer_native: bool = True,
     ):
         if isinstance(herding_method, str):
             if herding_method not in _METHODS:
@@ -113,6 +130,10 @@ class RehearsalMemory:
         self.herd = herding_method
         self.memory_size = memory_size
         self.fixed_memory = fixed_memory
+        # False forces the numpy herding path; multi-process trainers set it
+        # to the fleet-wide AND of native availability so replicated memories
+        # never diverge between hosts with and without the compiled library.
+        self.prefer_native = prefer_native
         if fixed_memory and not nb_total_classes:
             raise ValueError("fixed_memory=True requires nb_total_classes")
         self.nb_total_classes = nb_total_classes
@@ -148,6 +169,10 @@ class RehearsalMemory:
             if self.herd is herd_random:
                 # Distinct, deterministic stream per class.
                 rank = herd_random(np.asarray(features)[idx], q, seed=int(c) + 1)
+            elif self.herd is herd_barycenter:
+                rank = herd_barycenter(
+                    np.asarray(features)[idx], q, allow_native=self.prefer_native
+                )
             else:
                 rank = self.herd(np.asarray(features)[idx], q)
             keep = idx[rank]

@@ -102,11 +102,26 @@ class CilTrainer:
         )
         self.teacher: Optional[Teacher] = None
 
+        # Load/build the native host kernels at startup (never mid-epoch) and
+        # use them only when every process has them, so the replicated
+        # herding computation stays identical fleet-wide.
+        from ..utils.native import native_available
+
+        have_native = native_available()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            have_native = bool(
+                multihost_utils.process_allgather(
+                    np.asarray(have_native, np.int32)
+                ).min()
+            )
         self.memory = RehearsalMemory(
             memory_size=config.memory_size,
             herding_method=config.herding_method,
             fixed_memory=config.fixed_memory,
             nb_total_classes=self.nb_classes if config.fixed_memory else None,
+            prefer_native=have_native,
         )
         self.aug_cfg = AugmentConfig.from_config(config)
         self._steps: Dict[bool, callable] = {
@@ -234,7 +249,12 @@ class CilTrainer:
         lam = self._lambda_kd(task_id)
         pidx, pcount = jax.process_index(), jax.process_count()
         global_bs = self.global_batch_size
+        from ..utils.profiling import task_trace
+
         for epoch in range(cfg.num_epochs):
+            # Trace the first epoch of each task when profiling is on (the
+            # later epochs replay the same compiled program).
+            profile_here = cfg.profile_dir if epoch == 0 else None
             lr = cosine_lr(cfg.lr, epoch, cfg.num_epochs)
             # Same shuffle on every process (sampler.set_epoch equivalent,
             # reference template.py:253).
@@ -243,19 +263,22 @@ class CilTrainer:
                 jax.random.fold_in(self.root_key, task_id), epoch
             )
             pending: List[Dict] = []
-            for step_idx, (xb, yb) in enumerate(
-                train_batches(task_train, global_bs, shuffle_seed, pidx, pcount)
-            ):
-                xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
-                # Same key on every process (replicated jit operands must be
-                # process-consistent); per-image randomness comes from the
-                # split over the global batch inside train_augment.
-                key = jax.random.fold_in(epoch_key, step_idx)
-                x, y = self._put(xb, yb)
-                self.state, metrics = step_fn(
-                    self.state, self.teacher, x, y, key, lr, lam
-                )
-                pending.append(metrics)
+            with task_trace(profile_here, f"task{task_id}_epoch0"):
+                for step_idx, (xb, yb) in enumerate(
+                    train_batches(task_train, global_bs, shuffle_seed, pidx, pcount)
+                ):
+                    xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
+                    # Same key on every process (replicated jit operands must
+                    # be process-consistent); per-image randomness comes from
+                    # the split over the global batch inside train_augment.
+                    key = jax.random.fold_in(epoch_key, step_idx)
+                    x, y = self._put(xb, yb)
+                    self.state, metrics = step_fn(
+                        self.state, self.teacher, x, y, key, lr, lam
+                    )
+                    pending.append(metrics)
+                if profile_here:
+                    jax.block_until_ready(self.state.params)
             logger = MetricLogger(delimiter="  ")
             for m in pending:  # floatify once per epoch: no per-step sync
                 logger.update(**m)

@@ -1,0 +1,37 @@
+"""CLI experiment driver.
+
+Counterpart of the reference's ``__main__`` entry
+(``template.py:191-303``; launched via torchrun, ``README.md:352-354``).
+Here there is no launcher wrapper — a single process drives every local
+device through the mesh, and multi-host pods launch the same command per host
+(``jax.distributed`` auto-initializes).
+
+Run as ``python -m a_pytorch_tutorial_to_class_incremental_learning_tpu``
+or ``python train.py`` at the repo root, with the reference's flags::
+
+    python train.py --data_set cifar --num_bases 50 --increment 10 \\
+        --batch_size 128 --num_epochs 140
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from .config import config_from_args, get_args_parser
+from .engine import CilTrainer
+
+
+def main(argv: Optional[Sequence[str]] = None) -> dict:
+    parser = argparse.ArgumentParser(
+        "Class-Incremental Learning training and evaluation script (TPU)",
+        parents=[get_args_parser()],
+    )
+    args = parser.parse_args(argv)
+    config = config_from_args(args)
+    trainer = CilTrainer(config)
+    return trainer.fit()
+
+
+if __name__ == "__main__":
+    main()

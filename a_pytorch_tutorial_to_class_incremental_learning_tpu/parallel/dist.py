@@ -27,28 +27,76 @@ import jax
 import numpy as np
 
 _printer_installed = False
+_dist_initialized = False
+
+# Environment markers of multi-host launches.  Pure env inspection — nothing
+# here may touch a JAX backend, because ``jax.distributed.initialize`` must
+# run before the first backend use.
+_EXPLICIT_COORD_VARS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+# Comma-separated host lists: multi-host only when more than one entry
+# (single-host TPU VMs set these too, e.g. TPU_WORKER_HOSTNAMES=localhost).
+_HOST_LIST_VARS = ("TPU_WORKER_HOSTNAMES", "TPU_PROCESS_ADDRESSES")
 
 
 def is_dist_env() -> bool:
-    """True when launched under a multi-host coordinator (e.g. via
-    ``JAX_COORDINATOR_ADDRESS``/GKE/slurm env)."""
+    """True when launched in a recognizable multi-host environment."""
+    if any(k in os.environ for k in _EXPLICIT_COORD_VARS):
+        return True
+    if "MEGASCALE_COORDINATOR_ADDRESS" in os.environ:  # multi-slice Cloud TPU
+        return True
+    if int(os.environ.get("SLURM_JOB_NUM_NODES", "1")) > 1:
+        return True
     return any(
-        k in os.environ
-        for k in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+        "," in os.environ.get(k, "") for k in _HOST_LIST_VARS
     )
 
 
 def init_distributed_mode(dist_url: Optional[str] = None) -> None:
     """Initialize the JAX process group when running multi-host.
 
-    Single-process mode is fully supported (a deliberate fix of the
-    reference's mandatory-torchrun behaviour, utils.py:140-144).
+    Counterpart of the reference's NCCL bootstrap (utils.py:135-153), with two
+    deliberate differences: single-process mode is fully supported (the
+    reference hard-raises without torchrun, utils.py:140-144), and the guard
+    is **pure env inspection** — ``jax.distributed.initialize`` must be the
+    first JAX call, so nothing here may query process_count/devices before it
+    (doing so initializes the local backend and makes initialize() raise).
     """
-    if is_dist_env() and jax.process_count() == 1:
+    global _dist_initialized
+    if not _dist_initialized and is_dist_env():
+        _dist_initialized = True
         coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
             "COORDINATOR_ADDRESS"
         )
-        jax.distributed.initialize(coordinator_address=coord)
+        num = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID") or os.environ.get("PROCESS_ID")
+        explicit = coord is not None
+        try:
+            if coord and num is not None and pid is not None:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=int(num),
+                    process_id=int(pid),
+                )
+            elif coord:
+                # Coordinator given; num_processes/process_id from env
+                # auto-detection (Cloud TPU metadata, Slurm).
+                jax.distributed.initialize(coordinator_address=coord)
+            else:
+                # No explicit coordinator: fully auto-detected clusters.
+                jax.distributed.initialize()
+        except (RuntimeError, ValueError) as e:
+            if explicit:
+                # The user explicitly asked for multi-host; degrading to N
+                # independent single-process runs would silently duplicate
+                # training and corrupt shared checkpoints.  Fail fast.
+                raise
+            # Heuristic markers only (e.g. TPU metadata that merely *looks*
+            # multi-host) with an already-touched backend: degrade to
+            # single-process rather than kill a run that never needed
+            # coordination.
+            import sys
+
+            sys.stderr.write(f"| multi-host init skipped: {e}\n")
     setup_for_distributed(jax.process_index() == 0)
     if jax.process_index() == 0:
         print(

@@ -1,0 +1,127 @@
+"""ctypes bindings for the native host kernels (``csrc/cil_host.cpp``).
+
+The library is optional: every entry point has a numpy fallback, and
+:func:`load_native` attempts a one-shot ``make`` build when the shared object
+is missing but a compiler is available.  Use ``CIL_TPU_NO_NATIVE=1`` to force
+the numpy paths (the tests exercise both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libcilhost.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on any failure.
+
+    The build is serialized across processes with an ``flock`` on the csrc
+    directory so concurrent first-uses never read a half-written .so.  Call
+    this once at startup (``CilTrainer.__init__`` does) — the first call may
+    compile; later calls are a cached pointer read.
+    """
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("CIL_TPU_NO_NATIVE"):
+        return None
+    try:
+        if not os.path.exists(_LIB_PATH) and os.path.isdir(_CSRC):
+            import fcntl
+
+            with open(os.path.join(_CSRC, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    if not os.path.exists(_LIB_PATH):  # lost the race: built
+                        subprocess.run(
+                            ["make", "-C", _CSRC],
+                            check=True,
+                            capture_output=True,
+                            timeout=120,
+                        )
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.herd_barycenter.restype = ctypes.c_int
+        lib.herd_barycenter.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.gather_u8.restype = ctypes.c_int
+        lib.gather_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
+        _lib = lib
+    except (OSError, subprocess.SubprocessError):
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def herd_barycenter_native(features: np.ndarray, nb: int) -> Optional[np.ndarray]:
+    """C++ iCaRL greedy ranking; None when the library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    feats = np.ascontiguousarray(features, dtype=np.float32)
+    n, d = feats.shape
+    nb = min(nb, n)
+    out = np.empty(nb, np.int64)
+    rc = lib.herd_barycenter(
+        feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        d,
+        nb,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out if rc == 0 else None
+
+
+def gather_u8_native(src: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
+    """Multithreaded ``src[idx]`` for uint8 row-major arrays; None = fallback."""
+    lib = load_native()
+    if lib is None or src.dtype != np.uint8 or not src.flags.c_contiguous:
+        return None
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    item_bytes = int(np.prod(src.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx),) + src.shape[1:], np.uint8)
+    rc = lib.gather_u8(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(src),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx),
+        item_bytes,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        0,
+    )
+    return out if rc == 0 else None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Batch-assembly gather: native when possible, numpy otherwise."""
+    out = gather_u8_native(src, idx)
+    return src[idx] if out is None else out

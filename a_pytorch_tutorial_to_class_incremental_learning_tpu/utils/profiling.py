@@ -1,0 +1,28 @@
+"""Profiler hooks (SURVEY.md §5: absent in the reference, near-free in JAX).
+
+``task_trace`` wraps a region in a ``jax.profiler`` trace written to
+``profile_dir`` (viewable in TensorBoard / xprof / Perfetto); no-op when
+profiling is disabled.  ``annotate`` adds named sub-spans inside a trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def task_trace(profile_dir: Optional[str], name: str) -> Iterator[None]:
+    if not profile_dir:
+        yield
+        return
+    with jax.profiler.trace(profile_dir):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+def annotate(name: str):
+    """Named span inside an active trace (decorator/context manager)."""
+    return jax.profiler.TraceAnnotation(name)

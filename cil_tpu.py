@@ -1,6 +1,49 @@
-"""Convenience alias: ``import cil_tpu`` for the long-named package."""
-import sys as _sys
+"""Convenience alias: ``import cil_tpu`` for the long-named package.
 
-import a_pytorch_tutorial_to_class_incremental_learning_tpu as _pkg
+Submodules are importable under the alias too (``import cil_tpu.config``,
+``from cil_tpu.models import resnet``): a meta-path finder resolves any
+``cil_tpu.*`` name to the already-imported canonical module object, so both
+names always share one module instance (no duplicate class identities).
+"""
 
-_sys.modules[__name__] = _pkg
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+_CANONICAL = "a_pytorch_tutorial_to_class_incremental_learning_tpu"
+_pkg = importlib.import_module(_CANONICAL)
+sys.modules["cil_tpu"] = _pkg
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Hands the canonical module object to the import system unchanged.
+
+    The machinery overwrites ``module.__spec__``/``__name__`` with the alias
+    spec between ``create_module`` and ``exec_module``; ``exec_module``
+    restores the canonical ones so ``importlib.reload`` and spec-based
+    tooling keep working on the real module identity.
+    """
+
+    def create_module(self, spec):
+        module = importlib.import_module(_CANONICAL + spec.name[len("cil_tpu"):])
+        self._canonical_spec = module.__spec__
+        self._canonical_name = module.__name__
+        return module
+
+    def exec_module(self, module):
+        module.__spec__ = self._canonical_spec
+        module.__name__ = self._canonical_name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname.startswith("cil_tpu."):
+            return importlib.util.spec_from_loader(fullname, _AliasLoader())
+        return None
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    # Must precede PathFinder, which would otherwise resolve cil_tpu.<sub>
+    # through the parent's __path__ into a duplicate module instance.
+    sys.meta_path.insert(0, _AliasFinder())

@@ -1,0 +1,107 @@
+// Native host-side kernels for the TPU CIL framework.
+//
+// The reference inherits its native runtime from torch: the DataLoader's C++
+// worker pool moves/collates batches (reference template.py:236-239) and
+// continuum's herding runs in numpy.  Here the two host-side hot paths are
+// C++ with a ctypes ABI (no pybind11 in this toolchain):
+//
+//   * herd_barycenter: the iCaRL greedy exemplar ranking
+//     (reference README.md:134-136 derivation).  O(nb * n * d) with no
+//     temporary allocations — the numpy version materializes an [n, d]
+//     candidate-mean matrix per selection step.
+//   * gather_u8: multithreaded fancy-index gather of uint8 rows, the batch
+//     assembly step of the input pipeline (replaces DataLoader collate).
+//
+// Build: make -C csrc   (produces libcilhost.so; loaded via ctypes with a
+// numpy fallback, utils/native.py).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Rank `nb` of the `n` feature rows (row-major [n, d] float32) by iCaRL
+// barycenter greedy; writes selected indices in selection order to out[nb].
+// Returns 0 on success.
+int herd_barycenter(const float* feats, int64_t n, int64_t d, int64_t nb,
+                    int64_t* out) {
+  if (n <= 0 || d <= 0 || nb <= 0) return 1;
+  if (nb > n) nb = n;
+
+  std::vector<double> mu(d, 0.0);
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = feats + i * d;
+    for (int64_t j = 0; j < d; ++j) mu[j] += row[j];
+  }
+  for (int64_t j = 0; j < d; ++j) mu[j] /= static_cast<double>(n);
+
+  std::vector<double> running(d, 0.0);
+  std::vector<uint8_t> taken(n, 0);
+  for (int64_t k = 0; k < nb; ++k) {
+    const double inv = 1.0 / static_cast<double>(k + 1);
+    double best = std::numeric_limits<double>::infinity();
+    int64_t best_i = -1;
+    for (int64_t i = 0; i < n; ++i) {
+      if (taken[i]) continue;
+      const float* row = feats + i * d;
+      double dist = 0.0;
+      for (int64_t j = 0; j < d; ++j) {
+        const double diff = mu[j] - (running[j] + row[j]) * inv;
+        dist += diff * diff;
+      }
+      if (dist < best) {
+        best = dist;
+        best_i = i;
+      }
+    }
+    if (best_i < 0) return 2;
+    out[k] = best_i;
+    taken[best_i] = 1;
+    const float* row = feats + best_i * d;
+    for (int64_t j = 0; j < d; ++j) running[j] += row[j];
+  }
+  return 0;
+}
+
+// dst[i] = src[idx[i]] for rows of `item_bytes` bytes, fanned out over
+// `threads` workers (0 = hardware concurrency).
+int gather_u8(const uint8_t* src, int64_t n_src, const int64_t* idx,
+              int64_t n_out, int64_t item_bytes, uint8_t* dst,
+              int64_t threads) {
+  for (int64_t i = 0; i < n_out; ++i)
+    if (idx[i] < 0 || idx[i] >= n_src) return 1;
+  int64_t nt = threads > 0
+                   ? threads
+                   : static_cast<int64_t>(std::thread::hardware_concurrency());
+  if (nt < 1) nt = 1;
+  if (nt > n_out) nt = n_out;
+  // Below ~4 MB the thread spawn costs more than the copy.
+  if (n_out * item_bytes < (4 << 20)) nt = 1;
+
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      std::memcpy(dst + i * item_bytes, src + idx[i] * item_bytes,
+                  static_cast<size_t>(item_bytes));
+  };
+  if (nt == 1) {
+    worker(0, n_out);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  const int64_t chunk = (n_out + nt - 1) / nt;
+  for (int64_t t = 0; t < nt; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(n_out, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(worker, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+}  // extern "C"

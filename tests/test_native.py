@@ -1,0 +1,67 @@
+"""Native kernel parity: C++ herding/gather vs the numpy implementations."""
+
+import numpy as np
+import pytest
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="libcilhost.so unavailable"
+)
+
+
+def _numpy_herd(features, nb):
+    n = len(features)
+    nb = min(nb, n)
+    mu = features.mean(axis=0)
+    selected = np.zeros(n, bool)
+    order = np.empty(nb, np.int64)
+    running = np.zeros_like(mu)
+    for k in range(nb):
+        cand = (running[None, :] + features) / (k + 1)
+        dist = np.linalg.norm(mu[None, :] - cand, axis=1)
+        dist[selected] = np.inf
+        i = int(np.argmin(dist))
+        order[k] = i
+        selected[i] = True
+        running += features[i]
+    return order
+
+
+def test_herding_native_matches_numpy():
+    rng = np.random.RandomState(0)
+    for n, d, nb in ((30, 4, 10), (200, 64, 50), (5, 2, 5)):
+        feats = rng.randn(n, d).astype(np.float32)
+        ref = _numpy_herd(feats.astype(np.float64), nb)
+        got = native.herd_barycenter_native(feats, nb)
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_memory_uses_native_path():
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data import (
+        herd_barycenter,
+    )
+
+    rng = np.random.RandomState(1)
+    feats = rng.randn(100, 16).astype(np.float32)
+    np.testing.assert_array_equal(
+        herd_barycenter(feats, 20), _numpy_herd(feats.astype(np.float64), 20)
+    )
+
+
+def test_gather_native_matches_numpy():
+    rng = np.random.RandomState(2)
+    src = rng.randint(0, 256, (500, 32, 32, 3)).astype(np.uint8)
+    idx = rng.randint(0, 500, 4096)
+    got = native.gather_u8_native(src, idx)
+    np.testing.assert_array_equal(got, src[idx])
+    # Out-of-range indices are rejected, not UB.
+    assert native.gather_u8_native(src, np.array([500])) is None
+
+
+def test_gather_rows_object_fallback():
+    src = np.asarray(["a", "b", "c"], object)
+    np.testing.assert_array_equal(
+        native.gather_rows(src, np.array([2, 0])), src[[2, 0]]
+    )

@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Repo-root launcher: ``python train.py [flags]`` — the TPU-native
+equivalent of the reference's ``torchrun ... template.py`` command line."""
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.main import main
+
+if __name__ == "__main__":
+    main()
