@@ -40,10 +40,12 @@ def herd_barycenter(
     Returns the first ``nb`` selected indices, in selection order.  Dispatches
     to the C++ kernel (csrc/cil_host.cpp) when built — the greedy is
     O(nb*n*d) and this numpy version allocates an [n, d] candidate matrix per
-    selection step; the native path allocates nothing.  Both paths accumulate
-    in float64 over float32 inputs so their selections agree; in multi-process
-    runs the trainer disables the native path fleet-wide unless *every*
-    process has the library (replicated memories must stay bit-identical).
+    selection step; the native path allocates nothing.  Both paths use the
+    same arithmetic (float64 accumulation over float32 inputs, divide by k+1,
+    squared-distance argmin, first-index tie break); selections can differ
+    only on sub-ulp near-ties from summation order.  In multi-process runs
+    the trainer additionally disables the native path fleet-wide unless
+    *every* process has the library, so replicated memories stay identical.
     """
     if allow_native:
         from ..utils.native import herd_barycenter_native
@@ -62,7 +64,7 @@ def herd_barycenter(
     for k in range(nb):
         # candidate mean if sample i joins: (running_sum + z_i) / (k+1)
         cand = (running_sum[None, :] + features) / (k + 1)
-        dist = np.linalg.norm(mu[None, :] - cand, axis=1)
+        dist = ((mu[None, :] - cand) ** 2).sum(axis=1)
         dist[selected] = np.inf
         i = int(np.argmin(dist))
         order[k] = i

@@ -64,11 +64,15 @@ def sgd_update(
     lr: jax.Array,
     momentum: float,
     weight_decay: float,
+    frozen: Any = None,
 ) -> Tuple[Any, Any]:
     """torch.optim.SGD: g += wd·p;  buf = m·buf + g;  p -= lr·buf.
 
     Weight decay hits every parameter (the reference passes all of
-    ``model.parameters()``), dampening 0, no Nesterov.
+    ``model.parameters()``), dampening 0, no Nesterov.  ``frozen`` is an
+    optional boolean pytree (``models.freeze_mask``) — the JAX equivalent of
+    ``requires_grad=False``: frozen leaves receive no update and accumulate
+    no momentum.
     """
 
     new_buf = jax.tree_util.tree_map(
@@ -77,6 +81,10 @@ def sgd_update(
         grads,
         momentum_buf,
     )
+    if frozen is not None:
+        new_buf = jax.tree_util.tree_map(
+            lambda f, b: jnp.zeros_like(b) if f else b, frozen, new_buf
+        )
     new_params = jax.tree_util.tree_map(lambda p, b: p - lr * b, params, new_buf)
     return new_params, new_buf
 

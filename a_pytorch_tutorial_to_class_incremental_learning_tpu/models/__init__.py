@@ -31,6 +31,7 @@ from .cil_model import (  # noqa: F401
     CilModel,
     align,
     create_model,
+    freeze_mask,
     grow,
     init_backbone,
 )

@@ -139,6 +139,37 @@ def grow(variables: dict, key: jax.Array, known: int, nb_new: int) -> dict:
     return _set_fc(variables, grow_head(_get_fc(variables), key, known, nb_new))
 
 
+def freeze_mask(params: dict, names=("all",)) -> dict:
+    """Boolean pytree marking frozen parameters (True = no updates).
+
+    Counterpart of ``freeze_parameters`` / ``CilModel.freeze(names)``
+    (reference ``template.py:61-69,128-144``): ``"fc"`` freezes the
+    classifier head, ``"backbone"`` the feature extractor, ``"all"``
+    everything.  In JAX "requires_grad" does not exist — the optimizer
+    consumes this mask instead (``engine.sgd_update(frozen=...)``), and the
+    teacher needs no mask at all because gradients are only ever taken with
+    respect to the student.
+    """
+    valid = {"fc", "backbone", "all"}
+    for name in names:
+        if name not in valid:
+            raise NotImplementedError(f"Unknown module name to freeze {name}")
+
+    def mark(path, _leaf):
+        top = getattr(path[0], "key", getattr(path[0], "name", str(path[0])))
+        if "all" in names:
+            return True
+        if "fc" in names and top in ("fc_kernel", "fc_bias"):
+            return True
+        if "backbone" in names and top == "backbone":
+            return True
+        return False
+
+    import jax.tree_util as jtu
+
+    return jtu.tree_map_with_path(mark, params)
+
+
 def align(variables: dict, known: int, nb_new: int) -> Tuple[dict, float]:
     """Post-task weight alignment; no-op gate lives with the caller.
 

@@ -43,7 +43,7 @@ int herd_barycenter(const float* feats, int64_t n, int64_t d, int64_t nb,
   std::vector<double> running(d, 0.0);
   std::vector<uint8_t> taken(n, 0);
   for (int64_t k = 0; k < nb; ++k) {
-    const double inv = 1.0 / static_cast<double>(k + 1);
+    const double denom = static_cast<double>(k + 1);
     double best = std::numeric_limits<double>::infinity();
     int64_t best_i = -1;
     for (int64_t i = 0; i < n; ++i) {
@@ -51,7 +51,9 @@ int herd_barycenter(const float* feats, int64_t n, int64_t d, int64_t nb,
       const float* row = feats + i * d;
       double dist = 0.0;
       for (int64_t j = 0; j < d; ++j) {
-        const double diff = mu[j] - (running[j] + row[j]) * inv;
+        // Same arithmetic as the numpy fallback (divide, squared distance)
+        // so the two paths only differ by summation order (sub-ulp).
+        const double diff = mu[j] - (running[j] + row[j]) / denom;
         dist += diff * diff;
       }
       if (dist < best) {

@@ -48,7 +48,9 @@ def test_cli_flag_parity_with_reference():
 
 
 def test_alias_module_identity():
-    sys.path.insert(0, "/root/repo")
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import cil_tpu
     import cil_tpu.config as c1
     from a_pytorch_tutorial_to_class_incremental_learning_tpu import config as c2

@@ -1,12 +1,15 @@
 """Driver hooks stay importable and runnable on the virtual mesh."""
 
+import os
 import sys
 
 import jax
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_entry_compiles(devices8):
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, _REPO)
     import __graft_entry__ as g
 
     fn, args = g.entry()
@@ -15,7 +18,7 @@ def test_entry_compiles(devices8):
 
 
 def test_dryrun_multichip(devices8, capsys):
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, _REPO)
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)

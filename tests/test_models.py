@@ -204,3 +204,44 @@ def test_width_rounding_for_model_axis():
     model, variables = create_model("resnet20", nb_classes=100, width_multiple=8)
     assert model.width == 104
     assert variables["params"]["fc_kernel"].shape == (64, 104)
+
+
+def test_freeze_mask_semantics():
+    """Reference freeze(names) parity (template.py:61-69,128-144)."""
+    import pytest as _pytest
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        freeze_mask,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import (
+        sgd_init,
+        sgd_update,
+    )
+
+    _, variables = create_model("resnet20", nb_classes=10)
+    params = variables["params"]
+
+    mask_all = freeze_mask(params, ("all",))
+    assert all(jax.tree_util.tree_leaves(mask_all))
+    mask_fc = freeze_mask(params, ("fc",))
+    assert mask_fc["fc_kernel"] and mask_fc["fc_bias"]
+    assert not any(
+        jax.tree_util.tree_leaves({k: v for k, v in mask_fc.items()
+                                   if k not in ("fc_kernel", "fc_bias")})
+    )
+    with _pytest.raises(NotImplementedError):
+        freeze_mask(params, ("nope",))
+
+    # Frozen leaves receive no update through the optimizer.
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    new_params, buf = sgd_update(
+        params, grads, sgd_init(params), 0.1, 0.9, 0.0,
+        frozen=freeze_mask(params, ("backbone",)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(new_params["backbone"]["conv_1_3x3"]["kernel"]),
+        np.asarray(params["backbone"]["conv_1_3x3"]["kernel"]),
+    )
+    assert np.abs(
+        np.asarray(new_params["fc_kernel"]) - np.asarray(params["fc_kernel"])
+    ).max() > 0
