@@ -122,6 +122,7 @@ class CilConfig:
 
     # Precision
     compute_dtype: str = "float32"  # "bfloat16" enables MXU-friendly compute
+    use_pallas_loss: bool = False  # fused masked-CE Pallas kernel (ops/)
 
     # Checkpointing
     ckpt_dir: Optional[str] = None
@@ -218,6 +219,8 @@ def get_args_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true", default=False)
     p.add_argument("--profile_dir", default=None, type=str,
                    help="write a jax.profiler trace of each task's first epoch")
+    p.add_argument("--use_pallas_loss", action="store_true", default=False,
+                   help="use the fused masked-CE Pallas kernel for the train loss")
     return p
 
 
@@ -258,6 +261,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         dist_url=args.dist_url,
         mesh_shape=mesh_shape,
         compute_dtype=args.compute_dtype,
+        use_pallas_loss=args.use_pallas_loss,
         ckpt_dir=args.ckpt_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,

@@ -133,6 +133,7 @@ class CilTrainer:
                 momentum=config.momentum,
                 weight_decay=config.weight_decay,
                 has_teacher=has_teacher,
+                use_pallas_loss=config.use_pallas_loss,
             )
             for has_teacher in (False, True)
         }

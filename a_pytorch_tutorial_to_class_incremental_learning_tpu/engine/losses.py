@@ -76,8 +76,12 @@ def topk_correct(
     k: int,
     weights: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Weighted count of samples whose label is in the top-k masked logits."""
-    _, idx = jax.lax.top_k(logits, k)
+    """Weighted count of samples whose label is in the top-k masked logits.
+
+    ``k`` is clamped to the (static) logits width — the reference's
+    ``topk=(1, min(5, logits.shape[1]))`` guard (``template.py:179-180``).
+    """
+    _, idx = jax.lax.top_k(logits, min(k, logits.shape[-1]))
     hit = (idx == labels[:, None]).any(axis=-1).astype(jnp.float32)
     if weights is None:
         return hit.sum()

@@ -110,6 +110,7 @@ def make_train_step(
     momentum: float,
     weight_decay: float,
     has_teacher: bool,
+    use_pallas_loss: bool = False,
 ):
     """Build the jitted train step.
 
@@ -121,6 +122,12 @@ def make_train_step(
     ``lr`` and ``lambda_kd`` are traced scalars: the cosine schedule and the
     (optionally dynamic) KD weight change without recompilation.
     """
+
+    # The Pallas kernel compiles through Mosaic on TPU; on the CPU test mesh
+    # it runs interpreted; on any other backend (GPU) fall back to the XLA
+    # loss rather than silently emulating the kernel in the hot loop.
+    backend = jax.default_backend()
+    pallas_loss = use_pallas_loss and backend in ("tpu", "cpu")
 
     def step(
         state: TrainState,
@@ -141,7 +148,18 @@ def make_train_step(
                 train=True,
                 mutable=["batch_stats"],
             )
-            ce = cross_entropy(logits, labels, state.num_active, label_smoothing)
+            if pallas_loss:
+                from ..ops import fused_masked_cross_entropy
+
+                ce = fused_masked_cross_entropy(
+                    logits,
+                    labels,
+                    state.num_active,
+                    label_smoothing,
+                    backend == "cpu",
+                )
+            else:
+                ce = cross_entropy(logits, labels, state.num_active, label_smoothing)
             if has_teacher:
                 t_logits, _ = model.apply(
                     {"params": teacher.params, "batch_stats": teacher.batch_stats},

@@ -1,0 +1,9 @@
+"""Custom TPU ops (Pallas kernels).
+
+The compute path of this framework is XLA-compiled Flax (SURVEY.md §2c: at
+CIFAR-ResNet scale XLA fusion is already near peak), so Pallas is reserved
+for ops where generic fusion demonstrably leaves passes on the table — the
+fused masked-CE loss block is the reference pattern.
+"""
+
+from .fused_loss import fused_masked_cross_entropy  # noqa: F401

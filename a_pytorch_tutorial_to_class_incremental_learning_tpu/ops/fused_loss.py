@@ -1,0 +1,188 @@
+"""Pallas TPU kernel: fused masked cross-entropy with label smoothing.
+
+The train step's loss block over ``[B, width]`` masked logits
+(``engine/losses.cross_entropy``) lowers in XLA to several elementwise/reduce
+passes (mask, max, exp-sum, gather, smoothing-sum).  This kernel fuses the
+whole thing into one VMEM-resident pass per batch tile — forward produces the
+per-sample loss, and a custom VJP computes ``dlogits = p - target`` in a
+second single pass, never materializing intermediate ``[B, width]`` arrays in
+HBM.
+
+Numerically identical semantics to the reference's
+``CrossEntropyLoss(label_smoothing=s)`` over the active slice
+(reference ``template.py:219,259``): masked columns hold ``NEG_INF`` so the
+softmax is exactly the active-slice softmax; the smoothing target is
+``(1-s)·one-hot + s/num_active`` over active columns.
+
+Usage is optional (``CilConfig.use_pallas_loss``): the default path relies on
+XLA fusion, which at CIFAR scale is already near peak — this kernel exists
+for wide-head regimes (the loss block scales with ``B × width`` while the
+backbone does not) and as the framework's Pallas reference pattern.  Both
+paths are tested against each other (interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.classifier import NEG_INF
+
+LANE = 128  # TPU lane width: last-dim blocks must be multiples of this
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+# --------------------------------------------------------------------------- #
+# Kernels (one batch tile per grid step)
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(num_active_ref, logits_ref, labels_ref, loss_ref, *, smoothing):
+    x = logits_ref[:]  # [Bt, Wp] f32, inactive columns already NEG_INF
+    labels = labels_ref[:]  # [Bt, 1] i32
+    num_active = num_active_ref[0]
+
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    active = col < num_active
+
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    lse = m[:, 0] + jnp.log(jnp.sum(e, axis=1))
+    logp = x - lse[:, None]
+
+    onehot = col == labels
+    nll = -jnp.sum(jnp.where(onehot, logp, 0.0), axis=1)
+    if smoothing:
+        smooth = -jnp.sum(jnp.where(active, logp, 0.0), axis=1) / num_active.astype(
+            x.dtype
+        )
+        loss = (1.0 - smoothing) * nll + smoothing * smooth
+    else:
+        loss = nll
+    loss_ref[:] = loss[:, None]
+
+
+def _bwd_kernel(num_active_ref, logits_ref, labels_ref, g_ref, dx_ref, *, smoothing):
+    x = logits_ref[:]
+    labels = labels_ref[:]
+    g = g_ref[:]  # [Bt, 1] upstream cotangent per sample
+    num_active = num_active_ref[0]
+
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    active = col < num_active
+
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    p = e / jnp.sum(e, axis=1, keepdims=True)  # masked cols: exactly 0
+
+    onehot = (col == labels).astype(x.dtype)
+    target = (1.0 - smoothing) * onehot
+    if smoothing:
+        target = target + jnp.where(active, smoothing / num_active.astype(x.dtype), 0.0)
+    dx_ref[:] = (p - target) * g
+
+
+# --------------------------------------------------------------------------- #
+# Host-side wrapper with custom VJP
+# --------------------------------------------------------------------------- #
+
+
+def _pad_logits(logits: jax.Array) -> jax.Array:
+    wp = _round_up(logits.shape[1], LANE)
+    if wp == logits.shape[1]:
+        return logits
+    # NEG_INF padding is exactly the masking convention: padded columns carry
+    # zero probability and zero gradient.
+    return jnp.pad(logits, ((0, 0), (0, wp - logits.shape[1])),
+                   constant_values=NEG_INF)
+
+
+def _call(kernel, out_shape, num_active, logits, labels, *extra, interpret):
+    import math
+
+    b, wp = logits.shape
+    # Largest tile <= 256 that divides the batch (any b works; odd batches
+    # just get smaller tiles).
+    bt = math.gcd(b, 256)
+    grid = (b // bt,)
+    extra_specs = [
+        pl.BlockSpec((bt, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        for _ in extra
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        out_shape=out_shape,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # num_active [1]
+            pl.BlockSpec((bt, wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bt, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            *extra_specs,
+        ],
+        out_specs=pl.BlockSpec((bt, out_shape.shape[1]), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(num_active, logits, labels, *extra)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_masked_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    num_active: jax.Array,
+    label_smoothing: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Mean masked CE with label smoothing, fused in one Pallas pass.
+
+    Same contract as ``engine.losses.cross_entropy`` (without sample
+    weights).  ``interpret=True`` runs the kernel in the Pallas interpreter
+    (any backend — used by the CPU test suite).
+    """
+    loss, _ = _fwd(logits, labels, num_active, label_smoothing, interpret)
+    return loss
+
+
+def _fwd(logits, labels, num_active, label_smoothing, interpret):
+    b = logits.shape[0]
+    padded = _pad_logits(logits.astype(jnp.float32))
+    na = jnp.asarray(num_active, jnp.int32).reshape(1)
+    lab = labels.astype(jnp.int32).reshape(b, 1)
+    per = _call(
+        functools.partial(_fwd_kernel, smoothing=label_smoothing),
+        jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        na,
+        padded,
+        lab,
+        interpret=interpret,
+    )
+    return per[:, 0].mean(), (logits, labels, num_active)
+
+
+def _bwd(label_smoothing, interpret, residuals, g):
+    logits, labels, num_active = residuals
+    b, w = logits.shape
+    padded = _pad_logits(logits.astype(jnp.float32))
+    na = jnp.asarray(num_active, jnp.int32).reshape(1)
+    lab = labels.astype(jnp.int32).reshape(b, 1)
+    gcol = jnp.full((b, 1), g / b, jnp.float32)  # d(mean)/d(per-sample)
+    dx = _call(
+        functools.partial(_bwd_kernel, smoothing=label_smoothing),
+        jax.ShapeDtypeStruct((b, padded.shape[1]), jnp.float32),
+        na,
+        padded,
+        lab,
+        gcol,
+        interpret=interpret,
+    )
+    return dx[:, :w].astype(logits.dtype), None, None
+
+
+fused_masked_cross_entropy.defvjp(_fwd, _bwd)
