@@ -123,6 +123,9 @@ class CilConfig:
     # Precision
     compute_dtype: str = "float32"  # "bfloat16" enables MXU-friendly compute
     use_pallas_loss: bool = False  # fused masked-CE Pallas kernel (ops/)
+    fused_epochs: bool = True  # run each epoch as ONE lax.scan program with
+    # the task dataset resident on device (in-memory datasets only; lazy
+    # path-based datasets fall back to the per-batch host loop)
 
     # Checkpointing
     ckpt_dir: Optional[str] = None
@@ -221,6 +224,10 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of each task's first epoch")
     p.add_argument("--use_pallas_loss", action="store_true", default=False,
                    help="use the fused masked-CE Pallas kernel for the train loss")
+    p.add_argument("--no_fused_epochs", action="store_false",
+                   dest="fused_epochs", default=True,
+                   help="dispatch one device program per batch instead of "
+                   "one lax.scan program per epoch")
     return p
 
 
@@ -262,6 +269,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         mesh_shape=mesh_shape,
         compute_dtype=args.compute_dtype,
         use_pallas_loss=args.use_pallas_loss,
+        fused_epochs=args.fused_epochs,
         ckpt_dir=args.ckpt_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flax.core import unfreeze
+
 from ..config import CilConfig
 from ..data import (
     RehearsalMemory,
@@ -45,6 +47,7 @@ from .train import (
     Teacher,
     TrainState,
     cosine_lr,
+    make_epoch_fn,
     make_eval_step,
     make_feature_step,
     make_train_step,
@@ -91,8 +94,8 @@ class CilTrainer:
         variables = init_backbone(
             variables, init_key, self.model, config.input_size, channels
         )
-        params = shard_params(self.mesh, variables["params"])
-        batch_stats = shard_params(self.mesh, variables["batch_stats"])
+        params = shard_params(self.mesh, unfreeze(variables["params"]))
+        batch_stats = shard_params(self.mesh, unfreeze(variables["batch_stats"]))
         self.state = TrainState(
             params=params,
             batch_stats=batch_stats,
@@ -124,6 +127,17 @@ class CilTrainer:
             prefer_native=have_native,
         )
         self.aug_cfg = AugmentConfig.from_config(config)
+        # The Pallas loss runs interpreted on CPU (partitionable) and through
+        # Mosaic on TPU — but Mosaic kernels cannot be auto-partitioned, so
+        # on a multi-device TPU mesh fall back to the XLA loss rather than
+        # fail at compile time (shard_map wrapping is future work).
+        use_pallas = config.use_pallas_loss
+        if use_pallas and jax.default_backend() == "tpu" and self.mesh.size > 1:
+            print(
+                "| use_pallas_loss: multi-device TPU mesh not supported yet; "
+                "using the XLA loss"
+            )
+            use_pallas = False
         self._steps: Dict[bool, callable] = {
             has_teacher: make_train_step(
                 self.model,
@@ -133,7 +147,21 @@ class CilTrainer:
                 momentum=config.momentum,
                 weight_decay=config.weight_decay,
                 has_teacher=has_teacher,
-                use_pallas_loss=config.use_pallas_loss,
+                use_pallas_loss=use_pallas,
+            )
+            for has_teacher in (False, True)
+        }
+        self._epochs: Dict[bool, callable] = {
+            has_teacher: make_epoch_fn(
+                self.model,
+                self.aug_cfg,
+                label_smoothing=config.smooth,
+                kd_temperature=config.kd_temperature,
+                momentum=config.momentum,
+                weight_decay=config.weight_decay,
+                has_teacher=has_teacher,
+                mesh=self.mesh,
+                use_pallas_loss=use_pallas,
             )
             for has_teacher in (False, True)
         }
@@ -219,7 +247,7 @@ class CilTrainer:
         variables = grow(
             variables, jax.random.fold_in(self._grow_key, task_id), known, nb_new
         )
-        params = shard_params(self.mesh, variables["params"])
+        params = shard_params(self.mesh, unfreeze(variables["params"]))
         return state.replace(
             params=params,
             momentum=sgd_init(params),  # fresh SGD per task (template.py:246)
@@ -229,7 +257,7 @@ class CilTrainer:
 
     def _align_state(self, state: TrainState, known: int, nb_new: int):
         variables, gamma = align({"params": state.params}, known, nb_new)
-        params = shard_params(self.mesh, dict(variables["params"]))
+        params = shard_params(self.mesh, unfreeze(variables["params"]))
         return state.replace(params=params), gamma
 
     def _lambda_kd(self, task_id: int) -> float:
@@ -245,11 +273,20 @@ class CilTrainer:
         return n / (n + m)
 
     def _fit_task(self, task_id: int, task_train, dataset_val) -> None:
+        """Per-task epoch loop; the per-epoch work is delegated to either the
+        fused-epoch program or the per-batch step loop (same scaffold:
+        profiling, cosine LR, key derivation, metric logging, eval cadence).
+        """
         cfg = self.config
-        step_fn = self._steps[self.teacher is not None]
+        # Fused-epoch path: whole-epoch lax.scan with the dataset in HBM.
+        # Requires pixels in memory (lazy path-based datasets decode on the
+        # host per batch, so they keep the per-batch loop).
+        fused = cfg.fused_epochs and task_train.x.dtype == np.uint8
+        if fused:
+            rep = replicated(self.mesh)
+            # Dataset lives in HBM for the whole task (CIFAR-100: 150 MB).
+            data_x, data_y = self._put(task_train.x, task_train.y, sharding=rep)
         lam = self._lambda_kd(task_id)
-        pidx, pcount = jax.process_index(), jax.process_count()
-        global_bs = self.global_batch_size
         from ..utils.profiling import task_trace
 
         for epoch in range(cfg.num_epochs):
@@ -257,27 +294,18 @@ class CilTrainer:
             # later epochs replay the same compiled program).
             profile_here = cfg.profile_dir if epoch == 0 else None
             lr = cosine_lr(cfg.lr, epoch, cfg.num_epochs)
-            # Same shuffle on every process (sampler.set_epoch equivalent,
-            # reference template.py:253).
-            shuffle_seed = hash((cfg.seed, task_id, epoch)) & 0x7FFFFFFF
             epoch_key = jax.random.fold_in(
                 jax.random.fold_in(self.root_key, task_id), epoch
             )
-            pending: List[Dict] = []
             with task_trace(profile_here, f"task{task_id}_epoch0"):
-                for step_idx, (xb, yb) in enumerate(
-                    train_batches(task_train, global_bs, shuffle_seed, pidx, pcount)
-                ):
-                    xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
-                    # Same key on every process (replicated jit operands must
-                    # be process-consistent); per-image randomness comes from
-                    # the split over the global batch inside train_augment.
-                    key = jax.random.fold_in(epoch_key, step_idx)
-                    x, y = self._put(xb, yb)
-                    self.state, metrics = step_fn(
-                        self.state, self.teacher, x, y, key, lr, lam
+                if fused:
+                    pending = self._run_epoch_fused(
+                        data_x, data_y, epoch_key, lr, lam
                     )
-                    pending.append(metrics)
+                else:
+                    pending = self._run_epoch_steps(
+                        task_id, task_train, epoch, epoch_key, lr, lam
+                    )
                 if profile_here:
                     jax.block_until_ready(self.state.params)
             logger = MetricLogger(delimiter="  ")
@@ -291,6 +319,51 @@ class CilTrainer:
                 epoch + 1
             ) < cfg.num_epochs:
                 self.evaluate(dataset_val)
+
+    def _run_epoch_steps(
+        self, task_id: int, task_train, epoch: int, epoch_key, lr: float, lam: float
+    ) -> List[Dict]:
+        """One device dispatch per batch (lazy datasets / debugging)."""
+        cfg = self.config
+        step_fn = self._steps[self.teacher is not None]
+        pidx, pcount = jax.process_index(), jax.process_count()
+        # Same shuffle on every process (sampler.set_epoch equivalent,
+        # reference template.py:253).
+        shuffle_seed = hash((cfg.seed, task_id, epoch)) & 0x7FFFFFFF
+        pending: List[Dict] = []
+        for step_idx, (xb, yb) in enumerate(
+            train_batches(
+                task_train, self.global_batch_size, shuffle_seed, pidx, pcount
+            )
+        ):
+            xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
+            # Same key on every process (replicated jit operands must be
+            # process-consistent); per-image randomness comes from the split
+            # over the global batch inside train_augment.
+            key = jax.random.fold_in(epoch_key, step_idx)
+            x, y = self._put(xb, yb)
+            self.state, metrics = step_fn(
+                self.state, self.teacher, x, y, key, lr, lam
+            )
+            pending.append(metrics)
+        return pending
+
+    def _run_epoch_fused(self, data_x, data_y, epoch_key, lr: float, lam: float):
+        """One ``lax.scan`` program for the whole epoch (see ``make_epoch_fn``)."""
+        epoch_fn = self._epochs[self.teacher is not None]
+        self.state, metrics = epoch_fn(
+            self.state,
+            self.teacher,
+            data_x,
+            data_y,
+            epoch_key,
+            lr,
+            lam,
+            self.global_batch_size,
+        )
+        host = {k: np.asarray(v) for k, v in metrics.items()}
+        nb_steps = next(iter(host.values())).shape[0]
+        return [{k: v[i] for k, v in host.items()} for i in range(nb_steps)]
 
     # ------------------------------------------------------------------ #
     # Eval (reference template.py:169-188)

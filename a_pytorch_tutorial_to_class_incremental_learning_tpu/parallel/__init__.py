@@ -1,3 +1,10 @@
+"""Parallel runtime (L1): device mesh, sharding rules, multi-host bootstrap.
+
+TPU-native counterpart of the reference's NCCL/DDP layer (SURVEY.md #14,
+#23, #25): a ``data x model`` ``jax.sharding.Mesh`` with XLA-scheduled
+collectives replaces process groups, barriers and gradient hooks.
+"""
+
 from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
