@@ -25,6 +25,7 @@ from flax import struct
 from flax.core import unfreeze
 
 from ..data.augment import AugmentConfig, eval_preprocess, train_augment
+from ..parallel.mesh import batch_sharding
 from .losses import accuracy, cross_entropy, soft_target_kd, topk_correct
 
 
@@ -228,7 +229,7 @@ def make_epoch_fn(
     momentum: float,
     weight_decay: float,
     has_teacher: bool,
-    mesh=None,
+    mesh,
     use_pallas_loss: bool = False,
 ):
     """Build the fused-epoch program: shuffle + gather + every train step of
@@ -277,9 +278,7 @@ def make_epoch_fn(
         perm = jax.random.permutation(jax.random.fold_in(key, 0xC0FFEE), n)
         idx = jnp.resize(perm, (nb_steps, global_batch))
 
-        from ..parallel.mesh import batch_sharding as _bs
-
-        data_sharding = _bs(mesh)
+        data_sharding = batch_sharding(mesh)
 
         def body(carry, step_i):
             st = carry
