@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
 # The standard iCaRL/PODNet class order for CIFAR-100 used by the reference
@@ -133,12 +133,9 @@ class CilConfig:
 
     # Profiling (SURVEY.md §5: absent in the reference; near-free here)
     profile_dir: Optional[str] = None  # trace each task's first epoch
+    log_file: Optional[str] = None  # structured JSONL experiment log
 
     # ------------------------------------------------------------------ #
-
-    @property
-    def nb_tasks_for(self) -> None:  # pragma: no cover - documentation stub
-        raise AttributeError("use scenario length; task count depends on the dataset")
 
     def increments(self, nb_classes: int) -> Tuple[int, ...]:
         """Per-task class counts: ``[num_bases, increment, increment, ...]``.
@@ -222,6 +219,8 @@ def get_args_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true", default=False)
     p.add_argument("--profile_dir", default=None, type=str,
                    help="write a jax.profiler trace of each task's first epoch")
+    p.add_argument("--log_file", default=None, type=str,
+                   help="write a structured JSONL experiment log")
     p.add_argument("--use_pallas_loss", action="store_true", default=False,
                    help="use the fused masked-CE Pallas kernel for the train loss")
     p.add_argument("--no_fused_epochs", action="store_false",
@@ -273,4 +272,5 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         ckpt_dir=args.ckpt_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,
+        log_file=args.log_file,
     )

@@ -42,7 +42,7 @@ from ..data.augment import AugmentConfig
 from ..models import align, create_model, grow, init_backbone
 from ..parallel.dist import init_distributed_mode
 from ..parallel.mesh import batch_sharding, make_mesh, replicated, shard_params
-from ..utils.logging import MetricLogger
+from ..utils.logging import JsonlLogger, MetricLogger
 from .train import (
     Teacher,
     TrainState,
@@ -169,6 +169,8 @@ class CilTrainer:
         self.feature_step = make_feature_step(
             self.model, self.aug_cfg, augmented=config.herding_augmented
         )
+        # Resumed runs append so the pre-crash tasks' records survive.
+        self.jsonl = JsonlLogger(config.log_file, append=config.resume)
         self.acc1s: List[float] = []
         self.known = 0
         self.start_task = 0
@@ -213,14 +215,26 @@ class CilTrainer:
             self._fit_task(task_id, task_train, dataset_val)
 
             # Weight alignment after training, tasks > 0 (template.py:285-286).
+            gamma = None
             if task_id > 0:
                 self.state, gamma = self._align_state(self.state, self.known, nb_new)
                 print(f"old norm / new norm ={gamma}")
             acc1 = self.evaluate(dataset_val)
             self.acc1s.append(acc1)
+            task_s = time.time() - t0
             print(
                 f"task id = {task_id}  @Acc1 = {acc1:.5f}, acc1s = {self.acc1s}"
-                f"  ({time.time() - t0:.1f}s)"
+                f"  ({task_s:.1f}s)"
+            )
+            self.jsonl.log(
+                "task",
+                task_id=task_id,
+                acc1=acc1,
+                acc1s=list(self.acc1s),
+                gamma=gamma,
+                nb_new=nb_new,
+                known_after=self.known + nb_new,
+                seconds=round(task_s, 1),
             )
 
             # Teacher snapshot (template.py:290).  Copied, not aliased: the
@@ -236,6 +250,7 @@ class CilTrainer:
             self._save_checkpoint(task_id)
         avg_inc = float(np.mean(self.acc1s)) if self.acc1s else 0.0
         print(f"avg incremental top-1 = {avg_inc:.3f}")
+        self.jsonl.log("final", acc1s=list(self.acc1s), avg_incremental_acc1=avg_inc)
         return {
             "acc1s": self.acc1s,
             "avg_incremental_acc1": avg_inc,
@@ -314,6 +329,13 @@ class CilTrainer:
             logger.synchronize_between_processes()
             print(
                 f"train states: epoch :[{epoch + 1}/{cfg.num_epochs}] {logger}"
+            )
+            self.jsonl.log(
+                "epoch",
+                task_id=task_id,
+                epoch=epoch + 1,
+                lr=lr,
+                **{k: m.global_avg for k, m in logger.meters.items()},
             )
             if (epoch + 1) % cfg.eval_every_epoch == 0 and (
                 epoch + 1

@@ -99,6 +99,46 @@ class SmoothedValue:
         )
 
 
+class JsonlLogger:
+    """Structured experiment log: one JSON object per line.
+
+    The reference's only output channel is rank-0 stdout (SURVEY.md §5
+    "stdout only — no files, no structured logs"); this adds a
+    machine-readable record (epoch metrics, per-task accuracies, gamma,
+    timings) written by process 0.  Disabled when ``path`` is falsy.
+    """
+
+    def __init__(self, path: str | None, append: bool = False):
+        self.path = path
+        if path:
+            import os
+
+            import jax
+
+            # Only the writing process touches the filesystem: a late-starting
+            # non-zero host must neither truncate records already written by
+            # process 0 nor require a writable log directory.
+            if jax.process_index() != 0:
+                return
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            if not append:
+                open(path, "w").close()  # one file per fresh run
+
+    def log(self, record_type: str, **fields) -> None:
+        if not self.path:
+            return
+        import json
+        import time as _time
+
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        record = {"type": record_type, "ts": round(_time.time(), 3), **fields}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
 class MetricLogger:
     """Named collection of :class:`SmoothedValue` meters.
 

@@ -104,3 +104,28 @@ def test_profiler_trace_writes(devices8, tmp_path):
     assert found, "no profiler artifacts written"
     with task_trace(None, "disabled"):  # no-op path
         pass
+
+
+def test_jsonl_experiment_log(devices8, tmp_path):
+    import json
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.main import main
+
+    log = tmp_path / "run.jsonl"
+    main(
+        [
+            "--data_set", "synthetic10", "--num_bases", "0", "--increment", "5",
+            "--backbone", "resnet20", "--batch_size", "4", "--num_epochs", "2",
+            "--eval_every_epoch", "100", "--memory_size", "20", "--aa", "none",
+            "--seed", "6", "--log_file", str(log),
+        ]
+    )
+    records = [json.loads(ln) for ln in log.read_text().splitlines()]
+    types = [r["type"] for r in records]
+    assert types.count("epoch") == 4  # 2 tasks x 2 epochs
+    assert types.count("task") == 2
+    assert types[-1] == "final"
+    task_records = [r for r in records if r["type"] == "task"]
+    assert task_records[0]["gamma"] is None  # WA gated off for task 0
+    assert task_records[1]["gamma"] is not None
+    assert "acc1" in records[0] and "loss" in records[0]
