@@ -178,6 +178,11 @@ class CilTrainer:
             from ..utils.checkpoint import load_task_checkpoint
 
             load_task_checkpoint(self)
+        if config.resume:
+            # Segment marker: consumers can drop records before the last
+            # resume whose task_id >= start_task (a crash between a task's
+            # records and its checkpoint replays that task).
+            self.jsonl.log("resume", start_task=self.start_task)
 
     # ------------------------------------------------------------------ #
     # Batch placement
