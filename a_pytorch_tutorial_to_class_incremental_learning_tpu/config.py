@@ -120,8 +120,11 @@ class CilConfig:
     dist_url: str = "env://"       # kept for CLI parity; JAX uses its own init
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = all-devices x 1
 
-    # Precision
+    # Precision / normalization semantics
     compute_dtype: str = "float32"  # "bfloat16" enables MXU-friendly compute
+    bn_group_size: int = 0  # 0 = global-batch BN (idiomatic on TPU);
+    # 128 reproduces the reference's per-GPU-128 BN statistics exactly
+    # (DDP without SyncBN, SURVEY.md §7 item 2)
     use_pallas_loss: bool = False  # fused masked-CE Pallas kernel (ops/)
     fused_epochs: bool = True  # run each epoch as ONE lax.scan program with
     # the task dataset resident on device (in-memory datasets only; lazy
@@ -221,6 +224,9 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of each task's first epoch")
     p.add_argument("--log_file", default=None, type=str,
                    help="write a structured JSONL experiment log")
+    p.add_argument("--bn_group_size", default=0, type=int,
+                   help="BatchNorm statistics group size (0 = global batch; "
+                   "128 = reference per-GPU parity)")
     p.add_argument("--use_pallas_loss", action="store_true", default=False,
                    help="use the fused masked-CE Pallas kernel for the train loss")
     p.add_argument("--no_fused_epochs", action="store_false",
@@ -267,6 +273,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         dist_url=args.dist_url,
         mesh_shape=mesh_shape,
         compute_dtype=args.compute_dtype,
+        bn_group_size=args.bn_group_size,
         use_pallas_loss=args.use_pallas_loss,
         fused_epochs=args.fused_epochs,
         ckpt_dir=args.ckpt_dir,

@@ -86,6 +86,7 @@ class CilTrainer:
             width_multiple=self.mesh.shape["model"],
             input_size=config.input_size,
             channels=channels,
+            bn_group_size=config.bn_group_size,
         )
         self.root_key = jax.random.PRNGKey(config.seed)
         init_key, self._grow_key = jax.random.split(

@@ -38,9 +38,12 @@ class CilModel(nn.Module):
     backbone_name: str = "resnet32"
     width: int = 100
     dtype: Any = jnp.float32
+    bn_group_size: int = 0  # reference per-replica BN parity (models/norm.py)
 
     def setup(self):
-        self.backbone = get_backbone(self.backbone_name, dtype=self.dtype)
+        self.backbone = get_backbone(
+            self.backbone_name, dtype=self.dtype, bn_group_size=self.bn_group_size
+        )
         # Allocated zero; live columns are filled per task by `grow` with the
         # torch-Linear-equivalent init (classifier.py).
         self.fc_kernel = self.param(
@@ -84,6 +87,7 @@ def create_model(
     width_multiple: int = 1,
     input_size: int = 32,
     channels: int = 3,
+    bn_group_size: int = 0,
 ) -> Tuple[CilModel, dict]:
     """Build the module and its zero-head variables.
 
@@ -92,7 +96,10 @@ def create_model(
     :func:`grow` activates column ranges per task.
     """
     width = round_up(nb_classes, max(width_multiple, 1))
-    model = CilModel(backbone_name=backbone_name, width=width, dtype=dtype)
+    model = CilModel(
+        backbone_name=backbone_name, width=width, dtype=dtype,
+        bn_group_size=bn_group_size,
+    )
     dummy = jnp.zeros((1, input_size, input_size, channels), jnp.float32)
     variables = model.init(
         jax.random.PRNGKey(0), dummy, num_active=jnp.int32(0), train=False

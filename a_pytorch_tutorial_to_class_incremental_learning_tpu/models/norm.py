@@ -1,0 +1,91 @@
+"""Grouped (per-replica-style) BatchNorm.
+
+The reference trains with DDP and **no** SyncBN: every GPU normalizes its own
+128-sample sub-batch (SURVEY.md §7 hard-part 2).  The default here is
+global-batch statistics — the idiomatic choice under a jitted mesh program —
+but exact replication of the reference's statistics is available by
+normalizing in fixed-size groups along the batch axis: ``group_size=128``
+reproduces per-GPU-128 BN regardless of how many devices the batch is
+actually sharded over.  When groups align with device shards XLA keeps the
+reductions device-local (no collectives), which is also a (minor) speedup.
+
+Running averages aggregate the group statistics exactly the way N independent
+torch replicas would: each replica updates its running stats from its own
+batch stats, and DDP keeps replicas identical only because the *updates* are
+identical after the initial broadcast — which holds only in expectation.
+Here there is one set of running stats, updated with the mean over groups
+(the ensemble average of the reference's per-replica stats).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class GroupedBatchNorm(nn.Module):
+    """BatchNorm over fixed-size batch groups (``group_size=0`` = whole batch).
+
+    Drop-in for ``nn.BatchNorm(use_running_average=...)`` in NHWC networks.
+    """
+
+    group_size: int = 0
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, use_running_average: bool) -> jax.Array:
+        features = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(), (features,))
+        bias = self.param("bias", nn.initializers.zeros_init(), (features,))
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros(features, jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones(features, jnp.float32)
+        )
+
+        if use_running_average:
+            y = (x.astype(jnp.float32) - ra_mean.value) * jax.lax.rsqrt(
+                ra_var.value + self.epsilon
+            )
+            return (y * scale + bias).astype(self.dtype)
+
+        b = x.shape[0]
+        gs = self.group_size if self.group_size > 0 else b
+        if b % gs != 0:
+            raise ValueError(
+                f"batch {b} not divisible by bn group size {gs}"
+            )
+        g = b // gs
+        # Statistics in float32 regardless of compute dtype (flax BatchNorm
+        # does the same); only the normalized output drops to self.dtype.
+        xg = x.reshape((g, gs) + x.shape[1:]).astype(jnp.float32)
+        # Per-group statistics over (group-batch, H, W), like each DDP
+        # replica computing its own sub-batch stats.
+        axes = tuple(range(1, xg.ndim - 1))
+        mean_g = xg.mean(axis=axes, keepdims=True)
+        centered = xg - mean_g
+        var_g = (centered ** 2).mean(axis=axes, keepdims=True)
+        y = (centered * jax.lax.rsqrt(var_g + self.epsilon)).reshape(x.shape)
+
+        if not self.is_initializing():
+            n = gs * int(np.prod(x.shape[1:-1]))
+            # torch updates running_var with the *unbiased* batch variance
+            # (Bessel n/(n-1)) while normalizing with the biased one.
+            bessel = n / max(n - 1, 1)
+            ra_mean.value = (
+                self.momentum * ra_mean.value
+                + (1 - self.momentum) * mean_g.mean(axis=0).reshape(features)
+            )
+            ra_var.value = (
+                self.momentum * ra_var.value
+                + (1 - self.momentum)
+                * (var_g.mean(axis=0).reshape(features) * bessel)
+            )
+        return (y * scale + bias).astype(self.dtype)

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from .norm import GroupedBatchNorm
+
 # Matches torch's ``weight.data.normal_(0, sqrt(2/n))`` with
 # n = kh*kw*out_channels (reference resnet.py:83-85): variance-scaling with
 # scale 2.0 over fan-out; "normal" here is the untruncated normal with
@@ -54,6 +56,31 @@ class DownsampleA(nn.Module):
         return jnp.concatenate([x, jnp.zeros_like(x)], axis=-1)
 
 
+def _norm(
+    bn_group_size: int, train: bool, dtype, name: str
+) -> Callable[[jax.Array], jax.Array]:
+    """BatchNorm constructor: global-batch statistics by default, fixed-size
+    group statistics (the reference's per-replica BN, SURVEY.md §7 item 2)
+    when ``bn_group_size > 0``.  Both variants share parameter/stat names, so
+    checkpoints and teachers are interchangeable."""
+    if bn_group_size > 0:
+        gbn = GroupedBatchNorm(
+            group_size=bn_group_size,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=dtype,
+            name=name,
+        )
+        return lambda x: gbn(x, use_running_average=not train)
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-5,
+        dtype=dtype,
+        name=name,
+    )
+
+
 class BasicBlock(nn.Module):
     """conv3x3-BN-ReLU-conv3x3-BN + shortcut, post-add ReLU.
 
@@ -65,6 +92,7 @@ class BasicBlock(nn.Module):
     stride: int = 1
     downsample: bool = False
     dtype: Any = jnp.float32
+    bn_group_size: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -79,13 +107,7 @@ class BasicBlock(nn.Module):
             dtype=self.dtype,
             name="conv_a",
         )(x)
-        y = nn.BatchNorm(
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            name="bn_a",
-        )(y)
+        y = _norm(self.bn_group_size, train, self.dtype, "bn_a")(y)
         y = nn.relu(y)
         y = nn.Conv(
             self.planes,
@@ -97,13 +119,7 @@ class BasicBlock(nn.Module):
             dtype=self.dtype,
             name="conv_b",
         )(y)
-        y = nn.BatchNorm(
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            name="bn_b",
-        )(y)
+        y = _norm(self.bn_group_size, train, self.dtype, "bn_b")(y)
         if self.downsample:
             residual = DownsampleA(name="shortcut")(x)
         return nn.relu(residual + y)
@@ -120,6 +136,7 @@ class CifarResNet(nn.Module):
     depth: int = 32
     channels: int = 3  # 1 for the MNIST variants (reference resnet.py:127-139)
     dtype: Any = jnp.float32
+    bn_group_size: int = 0  # 0 = global-batch BN; e.g. 128 = per-replica parity
 
     @property
     def out_dim(self) -> int:
@@ -143,13 +160,7 @@ class CifarResNet(nn.Module):
             dtype=self.dtype,
             name="conv_1_3x3",
         )(x)
-        x = nn.BatchNorm(
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            name="bn_1",
-        )(x)
+        x = _norm(self.bn_group_size, train, self.dtype, "bn_1")(x)
         x = nn.relu(x)
         for stage, (planes, stride) in enumerate(((16, 1), (32, 2), (64, 2)), start=1):
             for i in range(n):
@@ -159,6 +170,7 @@ class CifarResNet(nn.Module):
                     stride=stride if first else 1,
                     downsample=first and stage > 1,
                     dtype=self.dtype,
+                    bn_group_size=self.bn_group_size,
                     name=f"stage_{stage}_block_{i}",
                 )(x, train=train)
         # Global 8x8 average pool + flatten -> [B, 64] feature vector
@@ -168,8 +180,11 @@ class CifarResNet(nn.Module):
 
 
 def _factory(depth: int, channels: int = 3) -> Callable[..., CifarResNet]:
-    def make(dtype: Any = jnp.float32) -> CifarResNet:
-        return CifarResNet(depth=depth, channels=channels, dtype=dtype)
+    def make(dtype: Any = jnp.float32, bn_group_size: int = 0) -> CifarResNet:
+        return CifarResNet(
+            depth=depth, channels=channels, dtype=dtype,
+            bn_group_size=bn_group_size,
+        )
 
     return make
 
@@ -197,9 +212,11 @@ _BACKBONES = {
 }
 
 
-def get_backbone(name: str, dtype: Any = jnp.float32) -> CifarResNet:
+def get_backbone(
+    name: str, dtype: Any = jnp.float32, bn_group_size: int = 0
+) -> CifarResNet:
     """Flag-string -> backbone module (reference ``template.py:72-84``)."""
     try:
-        return _BACKBONES[name](dtype=dtype)
+        return _BACKBONES[name](dtype=dtype, bn_group_size=bn_group_size)
     except KeyError:
         raise NotImplementedError(f"Unknown backbone {name}") from None

@@ -28,10 +28,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite is dominated by XLA compiles of the
 # train/epoch programs; caching them makes repeat runs several times faster.
+# XLA's extra AOT kernel caches are kept off — their strict machine-feature
+# check has been seen to mismatch the host's own detection ("prefer-no-gather
+# ... could lead to SIGILL" warnings) even on one machine.
 _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+try:
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+except AttributeError:  # older jax without the sub-knob
+    pass
 
 import pytest  # noqa: E402
 

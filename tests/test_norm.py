@@ -1,0 +1,109 @@
+"""GroupedBatchNorm: per-replica BN statistics parity (SURVEY.md §7 item 2)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.models.norm import (
+    GroupedBatchNorm,
+)
+
+
+def _apply(gbn, variables, x, train):
+    return gbn.apply(
+        variables, x, use_running_average=not train, mutable=["batch_stats"]
+    )
+
+
+def test_group_equals_whole_batch_when_group_is_batch():
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8, 8, 4).astype(np.float32))
+    whole = GroupedBatchNorm(group_size=0)
+    grouped = GroupedBatchNorm(group_size=16)
+    v = whole.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    y1, s1 = _apply(whole, v, x, train=True)
+    y2, s2 = _apply(grouped, v, x, train=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s1["batch_stats"]["mean"]),
+        np.asarray(s2["batch_stats"]["mean"]),
+        rtol=1e-5,
+    )
+
+
+def test_grouped_matches_torch_per_replica():
+    """Each group is normalized exactly like an independent torch BN replica
+    seeing only its sub-batch (DDP without SyncBN)."""
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(12, 4, 4, 3).astype(np.float32) * 2 + 1
+    gs = 4
+    gbn = GroupedBatchNorm(group_size=gs)
+    v = gbn.init(jax.random.PRNGKey(0), jnp.asarray(x), use_running_average=False)
+    y, stats = _apply(gbn, v, jnp.asarray(x), train=True)
+    y = np.asarray(y)
+
+    ref_means = []
+    ref_running_vars = []
+    for g in range(3):
+        bn = torch.nn.BatchNorm2d(3, momentum=0.1)
+        bn.train()
+        xg = torch.from_numpy(x[g * gs:(g + 1) * gs].transpose(0, 3, 1, 2))
+        ref = bn(xg).detach().numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(y[g * gs:(g + 1) * gs], ref, rtol=2e-4, atol=1e-5)
+        ref_means.append(xg.mean(dim=(0, 2, 3)).numpy())
+        ref_running_vars.append(bn.running_var.detach().numpy())
+    # Running stats update with the mean over groups' batch statistics;
+    # running_var uses torch's unbiased (Bessel-corrected) batch variance.
+    np.testing.assert_allclose(
+        np.asarray(stats["batch_stats"]["mean"]),
+        0.1 * np.mean(ref_means, axis=0),
+        rtol=1e-4,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats["batch_stats"]["var"]),
+        np.mean(ref_running_vars, axis=0),
+        rtol=1e-4,
+    )
+
+
+def test_eval_uses_running_stats():
+    x = jnp.asarray(np.random.RandomState(2).randn(8, 4, 4, 2).astype(np.float32))
+    gbn = GroupedBatchNorm(group_size=4)
+    v = gbn.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    y, _ = _apply(gbn, v, x, train=False)
+    # Init running stats are (0, 1): eval output == input (scale 1, bias 0).
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-4, atol=1e-5)
+
+
+def test_indivisible_group_raises():
+    x = jnp.ones((10, 4, 4, 2))
+    gbn = GroupedBatchNorm(group_size=4)
+    with pytest.raises(ValueError):
+        gbn.init(jax.random.PRNGKey(0), x, use_running_average=False)
+
+
+def test_backbone_with_grouped_bn_runs(devices8):
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+        grow,
+    )
+
+    model, v = create_model("resnet20", 10, bn_group_size=4)
+    v = grow(v, jax.random.PRNGKey(0), 0, 10)
+    x = jnp.ones((8, 32, 32, 3))
+    (logits, feats), _mutated = model.apply(
+        v, x, num_active=jnp.int32(10), train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (8, 10) and feats.shape == (8, 64)
+    # Same param/stat tree structure as the global-BN model: checkpoints and
+    # teachers interchange.  (grow() returns a FrozenDict wrapper; compare
+    # the unfrozen structures like the engine does.)
+    from flax.core import unfreeze
+
+    _model0, v0 = create_model("resnet20", 10)
+    assert jax.tree_util.tree_structure(unfreeze(v0)) == jax.tree_util.tree_structure(
+        unfreeze(v)
+    )
