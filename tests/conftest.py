@@ -14,9 +14,6 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep XLA's CPU compiler from oversubscribing the (often small) test machine.
-os.environ.setdefault("XLA_CPU_MULTI_THREAD_EAGER", "false")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
