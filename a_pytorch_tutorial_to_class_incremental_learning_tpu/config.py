@@ -233,6 +233,15 @@ def get_args_parser() -> argparse.ArgumentParser:
                    dest="fused_epochs", default=True,
                    help="dispatch one device program per batch instead of "
                    "one lax.scan program per epoch")
+    p.add_argument("--platform", default="default",
+                   choices=["default", "cpu", "tpu"],
+                   help="JAX platform to force before backend init "
+                   "(default = whatever the environment provides); 'cpu' "
+                   "enables running the full CLI without an accelerator")
+    p.add_argument("--host_devices", default=0, type=int,
+                   help="with --platform cpu: number of virtual CPU devices "
+                   "(xla_force_host_platform_device_count) for testing "
+                   "multi-device meshes without hardware")
     return p
 
 

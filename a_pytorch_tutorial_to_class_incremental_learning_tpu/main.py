@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 
 from .config import config_from_args, get_args_parser
 from .engine import CilTrainer
+from .utils.platform import force_platform
 
 
 def main(argv: Optional[Sequence[str]] = None) -> dict:
@@ -28,6 +29,12 @@ def main(argv: Optional[Sequence[str]] = None) -> dict:
         parents=[get_args_parser()],
     )
     args = parser.parse_args(argv)
+    if args.platform != "default":
+        # Must happen before config_from_args, which may touch jax.devices()
+        # to resolve the mesh shape.
+        force_platform(args.platform, args.host_devices)
+    elif args.host_devices:
+        parser.error("--host_devices requires --platform cpu")
     config = config_from_args(args)
     trainer = CilTrainer(config)
     return trainer.fit()

@@ -1,1 +1,2 @@
 from .logging import MetricLogger, SmoothedValue  # noqa: F401
+from .platform import force_platform  # noqa: F401

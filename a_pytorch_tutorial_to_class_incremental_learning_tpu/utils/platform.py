@@ -1,0 +1,82 @@
+"""JAX platform pinning, done before any backend initialization.
+
+The reference binds devices with ``torch.cuda.set_device`` per rank
+(reference ``utils.py:146``); in JAX the platform is a process-level choice
+made before the first backend-touching call.  This environment additionally
+pins ``JAX_PLATFORMS`` to an accelerator plugin via sitecustomize, so the
+env var alone cannot switch a process to CPU — ``jax.config`` must be
+updated too, early enough.
+
+This is the single shared implementation for repo code (``main.py``,
+``bench.py``); ``tests/conftest.py`` and ``__graft_entry__.py`` keep
+deliberately self-contained copies because they must run before the package
+is importable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def force_platform(
+    platform: str,
+    host_devices: int = 0,
+    compile_cache_dir: Optional[str] = None,
+) -> None:
+    """Pin the JAX platform; optionally fake CPU devices and set the cache.
+
+    ``host_devices > 0`` (CPU only) sets
+    ``xla_force_host_platform_device_count``, replacing any stale value —
+    the standard way to exercise a multi-device mesh without hardware.
+    Raises ``RuntimeError`` with a clear diagnostic when a different backend
+    was already initialized in this process (the pin cannot take effect).
+    """
+    if host_devices > 0:
+        if platform != "cpu":
+            raise ValueError(
+                "host_devices only applies to platform='cpu' "
+                f"(got platform={platform!r})"
+            )
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={host_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+        else:
+            flags = f"{flags} {want}"
+        os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ["JAX_PLATFORMS"] = platform
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except RuntimeError:
+        pass  # too late — diagnosed by the post-check below
+
+    if compile_cache_dir is not None:
+        jax.config.update("jax_compilation_cache_dir", compile_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+        except AttributeError:  # older jax without the sub-knob
+            pass
+
+    devs = jax.devices()
+    actual = devs[0].platform if devs else "none"
+    if actual != platform:
+        raise RuntimeError(
+            f"requested platform {platform!r} but a {actual!r} backend was "
+            "already initialized in this process — the platform must be "
+            "forced before any backend-touching call (run in a fresh process)"
+        )
+    if host_devices > 0 and len(devs) < host_devices:
+        raise RuntimeError(
+            f"requested {host_devices} virtual CPU devices but the backend "
+            f"initialized with {len(devs)} — the CPU backend was created "
+            "before the device-count flag could apply (fresh process needed)"
+        )

@@ -67,24 +67,15 @@ def probe_backend(timeout_s: float = 90.0) -> str:
 
 
 def force_cpu() -> None:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (
+        force_platform,
+    )
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except RuntimeError:
-        pass
     # Same persistent compile cache as conftest/dryrun: the fallback must not
     # repay the multi-minute XLA:CPU compile on every driver invocation.
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "tests", ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    try:
-        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
-    except AttributeError:
-        pass
+    force_platform("cpu", compile_cache_dir=cache)
 
 
 def _extract_flops(compiled) -> float | None:
@@ -244,7 +235,7 @@ def measure(batch_size: int, iters: int, compute_dtype: str, fused_n: int,
 
 
 def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
-         fused_n: int = 7000, with_bf16: bool = True):
+         fused_n: int = 7000, with_bf16: bool = True, cpu_full: bool = False):
     """``batch_size`` defaults to 512 — the reference's *global* batch
     (4 GPUs x 128), which fits comfortably on one v5e chip; a multi-chip mesh
     would use the per-device 128 of the config instead."""
@@ -257,11 +248,13 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
             # TPU-sized workload would run for hours there (and XLA:CPU
             # serializes the fused-epoch scan body, ~20x per-step slowdown),
             # so shrink it to keep the run well under any driver timeout.
-            reduced = True
-            batch_size = min(batch_size, 64)
-            iters = min(iters, 5)
-            fused_n = 0
-            with_bf16 = False
+            # --cpu_full opts out for a deliberate full CPU benchmark.
+            if not cpu_full:
+                reduced = True
+                batch_size = min(batch_size, 64)
+                iters = min(iters, 5)
+                fused_n = 0
+                with_bf16 = False
         result = measure(batch_size, iters, compute_dtype, fused_n, with_bf16)
         if reduced:
             result["reduced_cpu_fallback"] = True
@@ -289,5 +282,9 @@ if __name__ == "__main__":
                    help="dataset size for the fused-epoch measurement")
     p.add_argument("--no_bf16", action="store_true",
                    help="skip the extra bfloat16 step measurement")
+    p.add_argument("--cpu_full", action="store_true",
+                   help="run the full requested workload even on the CPU "
+                   "fallback (default shrinks it to stay under timeouts)")
     a = p.parse_args()
-    main(a.batch_size, a.iters, a.compute_dtype, a.fused_n, not a.no_bf16)
+    main(a.batch_size, a.iters, a.compute_dtype, a.fused_n, not a.no_bf16,
+         a.cpu_full)
