@@ -113,6 +113,7 @@ def _make_step_core(
     weight_decay: float,
     has_teacher: bool,
     use_pallas_loss: bool = False,
+    mesh=None,
 ):
     """The un-jitted train-step body shared by the per-step and fused-epoch
     paths: augment -> student forward (+ teacher forward) -> CE+λKD ->
@@ -120,9 +121,12 @@ def _make_step_core(
 
     # The Pallas kernel compiles through Mosaic on TPU; on the CPU test mesh
     # it runs interpreted; on any other backend (GPU) fall back to the XLA
-    # loss rather than silently emulating the kernel in the hot loop.
+    # loss rather than silently emulating the kernel in the hot loop.  On a
+    # multi-device mesh the kernel runs under shard_map (Mosaic kernels are
+    # not auto-partitionable) — one fused pass per batch stripe.
     backend = jax.default_backend()
     pallas_loss = use_pallas_loss and backend in ("tpu", "cpu")
+    pallas_sharded = pallas_loss and mesh is not None and mesh.size > 1
 
     def step(
         state: TrainState,
@@ -143,7 +147,18 @@ def _make_step_core(
                 train=True,
                 mutable=["batch_stats"],
             )
-            if pallas_loss:
+            if pallas_sharded:
+                from ..ops import sharded_fused_masked_cross_entropy
+
+                ce = sharded_fused_masked_cross_entropy(
+                    mesh,
+                    logits,
+                    labels,
+                    state.num_active,
+                    label_smoothing,
+                    backend == "cpu",
+                )
+            elif pallas_loss:
                 from ..ops import fused_masked_cross_entropy
 
                 ce = fused_masked_cross_entropy(
@@ -197,6 +212,7 @@ def make_train_step(
     weight_decay: float,
     has_teacher: bool,
     use_pallas_loss: bool = False,
+    mesh=None,
 ):
     """Build the jitted per-batch train step.
 
@@ -217,6 +233,7 @@ def make_train_step(
         weight_decay,
         has_teacher,
         use_pallas_loss,
+        mesh,
     )
     return jax.jit(step, donate_argnums=(0,))
 
@@ -261,6 +278,7 @@ def make_epoch_fn(
         weight_decay,
         has_teacher,
         use_pallas_loss,
+        mesh,
     )
 
     def epoch(

@@ -6,4 +6,7 @@ for ops where generic fusion demonstrably leaves passes on the table — the
 fused masked-CE loss block is the reference pattern.
 """
 
-from .fused_loss import fused_masked_cross_entropy  # noqa: F401
+from .fused_loss import (  # noqa: F401
+    fused_masked_cross_entropy,
+    sharded_fused_masked_cross_entropy,
+)

@@ -186,3 +186,39 @@ def _bwd(label_smoothing, interpret, residuals, g):
 
 
 fused_masked_cross_entropy.defvjp(_fwd, _bwd)
+
+
+def sharded_fused_masked_cross_entropy(
+    mesh,
+    logits: jax.Array,
+    labels: jax.Array,
+    num_active: jax.Array,
+    label_smoothing: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-device form of :func:`fused_masked_cross_entropy`.
+
+    Mosaic kernels cannot be auto-partitioned by XLA, so on a mesh the kernel
+    is wrapped in ``shard_map``: each device runs the fused pass over its own
+    batch stripe (full head width — XLA all-gathers the ``model``-sharded
+    columns into the shard, exactly what the softmax needs), and the equal
+    per-shard means are combined with one scalar ``pmean`` over the data
+    axis.  Differentiable: the custom VJP runs per shard, the cotangent of
+    ``pmean`` distributes the upstream 1/num_shards factor.
+    """
+    from ..parallel.mesh import DATA_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    def body(lg, lb, na):
+        local = fused_masked_cross_entropy(
+            lg, lb, na, label_smoothing, interpret
+        )
+        return jax.lax.pmean(local, DATA_AXIS)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=P(),
+        check_vma=False,  # pallas_call has no replication rule
+    )(logits, labels, num_active)
