@@ -47,6 +47,41 @@ def make_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def assert_process_major(mesh: Mesh) -> None:
+    """Fail loudly when the mesh's data axis is not process-major.
+
+    ``data/loader.py`` hands each process the contiguous stripe
+    ``[pidx·per_proc, (pidx+1)·per_proc)`` of every global batch, and
+    ``jax.make_array_from_process_local_data`` assembles the global array in
+    the sharding's device order — the two agree only when process ``p`` owns
+    exactly the ``p``-th contiguous block of data-axis rows.  That holds for
+    every standard mesh (``jax.devices()`` is process-major), but an exotic
+    topology would silently permute the global batch across hosts
+    (accuracy-neutral, parity-relevant) or, with a model axis spanning
+    processes, feed replicated shards divergent content.  Checked once at
+    trainer init.
+    """
+    nrows = mesh.devices.shape[0]
+    owners = []  # per data-row: the set of owning processes
+    for row in mesh.devices.reshape(nrows, -1):
+        procs = {d.process_index for d in row}
+        if len(procs) > 1:
+            raise RuntimeError(
+                "mesh data-axis row spans processes "
+                f"{sorted(procs)}: the model axis crosses hosts, which the "
+                "contiguous-stripe loader (data/loader.py) cannot feed — "
+                "reshape the mesh so each host owns whole data rows"
+            )
+        owners.append(procs.pop())
+    if any(b < a for a, b in zip(owners, owners[1:])):
+        raise RuntimeError(
+            f"mesh data axis is not process-major (row owners {owners}): "
+            "the contiguous-stripe loader would permute the global batch "
+            "across hosts — order devices process-major when building the "
+            "mesh"
+        )
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading-axis (batch) sharding over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))
