@@ -8,13 +8,14 @@ with iCaRL "barycenter" greedy herding as the default ranking
 
 Semantics:
 
-* ``add(x, y, t, features)`` ranks each **new** class's samples by the
-  herding method on the given feature vectors (computed by the current
-  post-weight-align model, reference ``template.py:292-302``) and stores them
-  in rank order.  Classes already in memory keep their existing ranking
-  (re-adding injected old exemplars is a no-op) — truncation to the new
-  quota keeps the best-ranked prefix, which is exactly iCaRL's shrinking
-  exemplar-set rule.
+* ``add(x, y, t, features)`` ranks **every** class present in the added data
+  by the herding method on the given feature vectors (computed by the
+  current post-weight-align model, reference ``template.py:292-302``).  For
+  old classes the candidates are exactly the stored exemplars (they were
+  injected into the task data), so this re-ranks them with *current-model*
+  features — continuum 1.2.2's behavior, which decides which exemplars
+  survive the quota shrink.  Classes absent from the added data keep their
+  old ranking and are truncated to the new quota.
 * ``fixed_memory=False`` (reference default): quota = memory_size //
   nb_seen_classes.  ``True``: memory_size // total_classes fixed slots.
 * ``get()`` returns concatenated ``(x, y, t)`` over all stored classes, ready
@@ -164,9 +165,10 @@ class RehearsalMemory:
         y = np.asarray(y)
         if t is None:
             t = np.full(len(y), -1, np.int64)
-        new_classes = [c for c in np.unique(y) if int(c) not in self._store]
-        q = self.quota(len(self._store) + len(new_classes))
-        for c in new_classes:
+        seen_classes = np.unique(y)
+        nb_after = len(set(self._store) | {int(c) for c in seen_classes})
+        q = self.quota(nb_after)
+        for c in seen_classes:
             idx = np.where(y == c)[0]
             if self.herd is herd_random:
                 # Distinct, deterministic stream per class.

@@ -146,16 +146,40 @@ def test_memory_quota_shrinks():
     assert all(v == 20 for v in counts.values())
 
 
-def test_memory_keeps_old_ranking_on_readd():
-    mem = RehearsalMemory(memory_size=60, herding_method="barycenter")
-    x, y, t, f = _class_batch([0, 1])
-    mem.add(x, y, t, f)
-    x0, _, _ = mem.get()
-    # Re-adding the same classes (the reference re-adds injected exemplars,
-    # template.py:300-302) must not change the stored selection.
-    mem.add(x, y, t, np.random.RandomState(9).randn(*f.shape))
-    x1, _, _ = mem.get()
-    np.testing.assert_array_equal(x0, x1)
+def test_memory_reranks_old_classes_with_current_features():
+    """continuum 1.2.2 semantics (reference template.py:300-302): old classes
+    present in the added data — i.e. the injected exemplars — are re-ranked
+    with the *current* model's features, which decides who survives the
+    quota shrink."""
+    rng = np.random.RandomState(0)
+    y = np.repeat(np.asarray([0], np.int64), 8)
+    x = np.arange(8, dtype=np.uint8).reshape(8, 1, 1, 1)  # identifiable rows
+    t = np.zeros(8, np.int64)
+    mem = RehearsalMemory(memory_size=8, herding_method="barycenter")
+    mem.add(x, y, t, rng.randn(8, 4))
+    x0, _, _ = mem.get()  # all 8 kept (quota 8), in rank order
+
+    # New task: class 1 appears, quota shrinks to 4; the stored class-0
+    # exemplars come back through the task data with fresh features whose
+    # herding order is the reverse of the stored one.
+    feats0 = np.zeros((8, 4))
+    feats0[:, 0] = np.argsort(-x0[:, 0, 0, 0].astype(np.float64))  # reverse
+    x1cls = np.full((8, 1, 1, 1), 100, np.uint8)
+    xa = np.concatenate([x0, x1cls])
+    ya = np.concatenate([y, np.ones(8, np.int64)])
+    ta = np.zeros(16, np.int64)
+    fa = np.concatenate([feats0, rng.randn(8, 4)])
+    mem.add(xa, ya, ta, fa)
+    xk, yk, _ = mem.get()
+    kept0 = set(xk[yk == 0, 0, 0, 0].tolist())
+    # The kept set follows the NEW ranking, not the original insertion rank:
+    # herding on feats0 picks points nearest the feature mean first, which is
+    # a property of feats0, not of the stored order.  Just assert the kept
+    # set equals the first 4 of the new herding order.
+    new_rank = herd_barycenter(feats0.astype(np.float32), 4)
+    expect = set(x0[new_rank, 0, 0, 0].tolist())
+    assert kept0 == expect
+    assert int((yk == 1).sum()) == 4
 
 
 def test_fixed_memory_quota():
