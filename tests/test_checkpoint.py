@@ -5,6 +5,7 @@ import os
 
 import numpy as np
 import jax
+import pytest
 
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import CilTrainer
@@ -12,6 +13,8 @@ from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import m
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.checkpoint import (
     latest_task_checkpoint,
 )
+
+pytestmark = pytest.mark.heavy  # e2e/multi-process tier; excluded from -m quick
 
 
 def _cfg(**kw):

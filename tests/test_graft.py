@@ -4,6 +4,9 @@ import os
 import sys
 
 import jax
+import pytest
+
+pytestmark = pytest.mark.heavy  # e2e/multi-process tier; excluded from -m quick
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

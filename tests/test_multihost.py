@@ -18,6 +18,8 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.heavy  # e2e/multi-process tier; excluded from -m quick
+
 _WORKER = r"""
 import json, os, sys
 sys.path.insert(0, os.environ["CIL_REPO"])
