@@ -173,40 +173,55 @@ def _affine(img: jax.Array, mat: jax.Array) -> jax.Array:
     return out
 
 
-def _rotate(img: jax.Array, degrees: jax.Array) -> jax.Array:
-    """Rotation about the image center (PIL ``img.rotate`` semantics)."""
-    h, w = img.shape[0], img.shape[1]
+def _rotate_matrix(img_shape, degrees: jax.Array) -> jax.Array:
+    """Rotation about the image center (PIL ``img.rotate`` semantics),
+    output->input: translate to center, rotate, translate back."""
+    h, w = img_shape[0], img_shape[1]
     cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
     rad = jnp.deg2rad(degrees)
     c, s = jnp.cos(rad), jnp.sin(rad)
-    # output->input: translate to center, rotate, translate back
-    mat = jnp.array(
+    return jnp.array(
         [
             [c, -s, cx - c * cx + s * cy],
             [s, c, cy - s * cx - c * cy],
         ]
     )
-    return _affine(img, mat)
+
+
+def _shear_x_matrix(v: jax.Array) -> jax.Array:
+    return jnp.array([[1.0, v, 0.0], [0.0, 1.0, 0.0]])
+
+
+def _shear_y_matrix(v: jax.Array) -> jax.Array:
+    return jnp.array([[1.0, 0.0, 0.0], [v, 1.0, 0.0]])
+
+
+def _translate_x_matrix(pixels: jax.Array) -> jax.Array:
+    return jnp.array([[1.0, 0.0, pixels], [0.0, 1.0, 0.0]])
+
+
+def _translate_y_matrix(pixels: jax.Array) -> jax.Array:
+    return jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, pixels]])
+
+
+def _rotate(img: jax.Array, degrees: jax.Array) -> jax.Array:
+    return _affine(img, _rotate_matrix(img.shape, degrees))
 
 
 def _shear_x(img: jax.Array, v: jax.Array) -> jax.Array:
-    mat = jnp.array([[1.0, v, 0.0], [0.0, 1.0, 0.0]], jnp.float32)
-    return _affine(img, mat * jnp.ones(()))
+    return _affine(img, _shear_x_matrix(v))
 
 
 def _shear_y(img: jax.Array, v: jax.Array) -> jax.Array:
-    mat = jnp.array([[1.0, 0.0, 0.0], [v, 1.0, 0.0]], jnp.float32)
-    return _affine(img, mat)
+    return _affine(img, _shear_y_matrix(v))
 
 
 def _translate_x(img: jax.Array, pixels: jax.Array) -> jax.Array:
-    mat = jnp.array([[1.0, 0.0, pixels], [0.0, 1.0, 0.0]])
-    return _affine(img, mat)
+    return _affine(img, _translate_x_matrix(pixels))
 
 
 def _translate_y(img: jax.Array, pixels: jax.Array) -> jax.Array:
-    mat = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, pixels]])
-    return _affine(img, mat)
+    return _affine(img, _translate_y_matrix(pixels))
 
 
 # --------------------------------------------------------------------------- #
@@ -225,12 +240,14 @@ def _blend(a: jax.Array, b: jax.Array, factor: jax.Array) -> jax.Array:
     return a + factor * (b - a)
 
 
-def _color(img, factor):  # saturation
-    return _blend(jnp.broadcast_to(_grayscale(img), img.shape), img, factor)
+def _color(img, factor, gray=None):  # saturation
+    gray = _grayscale(img) if gray is None else gray
+    return _blend(jnp.broadcast_to(gray, img.shape), img, factor)
 
 
-def _contrast(img, factor):
-    mean = jnp.round(_grayscale(img).mean())
+def _contrast(img, factor, gray=None):
+    gray = _grayscale(img) if gray is None else gray
+    mean = jnp.round(gray.mean())
     return _blend(jnp.full_like(img, mean), img, factor)
 
 
@@ -319,29 +336,62 @@ def _equalize(img, _):
 # --------------------------------------------------------------------------- #
 
 
+def _geom_matrix(img_shape, op_idx: jax.Array, frac: jax.Array,
+                 sign: jax.Array, size: int) -> jax.Array:
+    """Per-image 2x3 affine matrix for the geometric RandAugment ops
+    (identity for every non-geometric op index).
+
+    Under vmap, ``lax.switch`` computes every branch and selects — so five
+    separate bilinear warps (rotate, 2 shears, 2 translates) would each pay
+    their own 4-tap gather over the whole batch.  Selecting the *matrix*
+    instead is scalar work, and one shared warp serves all five ops.
+    """
+    rot = _rotate_matrix(img_shape, sign * frac * 30.0)
+    v = sign * frac * 0.3
+    shear_x = _shear_x_matrix(v)
+    shear_y = _shear_y_matrix(v)
+    px = sign * frac * 0.45 * size
+    trans_x = _translate_x_matrix(px)
+    trans_y = _translate_y_matrix(px)
+    ident = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+    # Op table order (see _ra_apply): geometric ops sit at 3, 11, 12, 13, 14.
+    mats = jnp.stack(
+        [ident, ident, ident, rot, ident, ident, ident, ident, ident, ident,
+         ident, shear_x, shear_y, trans_x, trans_y]
+    )
+    return mats[op_idx]
+
+
 def _ra_apply(img: jax.Array, op_idx: jax.Array, magnitude: jax.Array,
               sign: jax.Array, size: int) -> jax.Array:
     """Apply op ``op_idx`` at ``magnitude`` (in [0, 10]); ``sign`` is ±1."""
     frac = magnitude / 10.0
 
+    # ONE bilinear warp shared by all five geometric branches (the matrix is
+    # op-selected, identity resamples exactly); grayscale shared by
+    # color/contrast.  The remaining switch branches are cheap elementwise
+    # passes, so compute-all-and-select stays cheap.
+    warped = _affine(img, _geom_matrix(img.shape, op_idx, frac, sign, size))
+    gray = _grayscale(img)
+
     branches = [
         lambda im: _autocontrast(im, None),
         lambda im: _equalize(im, None),
         lambda im: _invert(im, None),
-        lambda im: _rotate(im, sign * frac * 30.0),
+        lambda im: warped,  # rotate
         # Posterize "increasing": 4 - int(frac * 4) bits
         lambda im: _posterize(im, 4.0 - jnp.floor(frac * 4.0)),
         # Solarize "increasing": threshold 256 - int(frac * 256)
         lambda im: _solarize(im, 256.0 - jnp.floor(frac * 256.0)),
         lambda im: _solarize_add(im, jnp.floor(frac * 110.0)),
-        lambda im: _color(im, 1.0 + sign * frac * 0.9),
-        lambda im: _contrast(im, 1.0 + sign * frac * 0.9),
+        lambda im: _color(im, 1.0 + sign * frac * 0.9, gray),
+        lambda im: _contrast(im, 1.0 + sign * frac * 0.9, gray),
         lambda im: _brightness(im, 1.0 + sign * frac * 0.9),
         lambda im: _sharpness(im, 1.0 + sign * frac * 0.9),
-        lambda im: _shear_x(im, sign * frac * 0.3),
-        lambda im: _shear_y(im, sign * frac * 0.3),
-        lambda im: _translate_x(im, sign * frac * 0.45 * size),
-        lambda im: _translate_y(im, sign * frac * 0.45 * size),
+        lambda im: warped,  # shear_x
+        lambda im: warped,  # shear_y
+        lambda im: warped,  # translate_x
+        lambda im: warped,  # translate_y
     ]
     return _round_u8(lax.switch(op_idx, branches, img))
 
