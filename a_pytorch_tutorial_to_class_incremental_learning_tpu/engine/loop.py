@@ -176,6 +176,26 @@ class CilTrainer:
         )
         # Resumed runs append so the pre-crash tasks' records survive.
         self.jsonl = JsonlLogger(config.log_file, append=config.resume)
+        # Provenance header: committed logs are only evidence if a reader can
+        # see exactly what produced them.
+        self.jsonl.log(
+            "run",
+            data_set=config.data_set,
+            backbone=config.backbone,
+            num_bases=config.num_bases,
+            increment=config.increment,
+            batch_size=config.batch_size,
+            global_batch=self.global_batch_size,
+            num_epochs=config.num_epochs,
+            lr=config.lr,
+            seed=config.seed,
+            aa=config.aa,
+            memory_size=config.memory_size,
+            compute_dtype=config.compute_dtype,
+            backend=jax.default_backend(),
+            mesh=dict(self.mesh.shape),
+            processes=jax.process_count(),
+        )
         self.acc1s: List[float] = []
         self.known = 0
         self.start_task = 0

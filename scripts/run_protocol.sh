@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Protocol-scale evidence runs: the full 10-task B0-inc10 and 6-task
+# B50-inc10 class-incremental protocols (reference template.py:226-303) on
+# synthetic-100, JSONL-logged into experiments/.  Reduced epochs by default —
+# the point is the WA mechanism working over every task (head growth, KD,
+# weight alignment, herding, shrinking quotas), not peak accuracy.
+#
+#   EPOCHS=8 ./scripts/run_protocol.sh                       # real chip
+#   PLATFORM_ARGS="--platform cpu --host_devices 8" ...      # virtual mesh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p experiments
+
+EPOCHS=${EPOCHS:-8}
+SEED=${SEED:-0}
+PLATFORM_ARGS=${PLATFORM_ARGS:-}
+AA=${AA:-None}  # RandAugment off by default: compile cost, see tests/test_augment.py
+
+python train.py --data_set synthetic --num_bases 0 --increment 10 \
+  --backbone resnet32 --batch_size 128 --num_epochs "$EPOCHS" --aa "$AA" \
+  --seed "$SEED" $PLATFORM_ARGS --log_file experiments/b0_inc10_synthetic.jsonl
+
+python train.py --data_set synthetic --num_bases 50 --increment 10 \
+  --backbone resnet32 --batch_size 128 --num_epochs "$EPOCHS" --aa "$AA" \
+  --seed "$SEED" $PLATFORM_ARGS --log_file experiments/b50_inc10_synthetic.jsonl
+
+python scripts/summarize_results.py experiments/*.jsonl > RESULTS.md
+echo "wrote RESULTS.md"
