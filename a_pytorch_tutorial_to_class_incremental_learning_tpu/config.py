@@ -242,6 +242,11 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="with --platform cpu: number of virtual CPU devices "
                    "(xla_force_host_platform_device_count) for testing "
                    "multi-device meshes without hardware")
+    p.add_argument("--compile_cache",
+                   default="~/.cache/cil_tpu/xla_cache",
+                   help="persistent XLA compilation cache directory; repeat "
+                   "runs and repeated task shapes then skip compilation "
+                   "('' disables)")
     return p
 
 

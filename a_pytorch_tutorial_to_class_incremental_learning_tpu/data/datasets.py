@@ -69,20 +69,38 @@ def load_synthetic(
     channels: int = 3,
     train: bool = True,
     seed: int = 1234,
+    noise_std: float = 48.0,
 ) -> Arrays:
     """Class-separable synthetic data: per-class template image + pixel noise.
 
     Deterministic in ``seed`` (train/val draw disjoint noise), learnable well
     above chance by a small CNN — the dataset used by tests, ``bench.py`` and
     the multi-chip dry-run, where real data may not exist on disk.
+
+    Templates are **low-frequency**: a coarse random grid upsampled 4x and
+    box-blurred.  Per-pixel white-noise templates decorrelate completely under
+    a 1-pixel shift, so the padded-RandomCrop augmentation (±4 px) turns each
+    class into ~81 unrelated patterns and a small CNN stays at chance; smooth
+    templates keep shifted crops correlated, like natural images.
     """
     rng = np.random.RandomState(seed)
-    templates = rng.randint(
-        0, 256, size=(nb_classes, input_size, input_size, channels)
-    ).astype(np.float32)
+    lo = max(2, input_size // 4)
+    up = -(-input_size // lo)  # ceil: upsampled size covers any input_size
+    coarse = rng.randint(0, 256, size=(nb_classes, lo, lo, channels))
+    templates = np.kron(
+        coarse.astype(np.float32), np.ones((1, up, up, 1))
+    )[:, :input_size, :input_size, :]
+    for axis in (1, 2):  # separable 3-tap box blur to soften block edges
+        templates = (
+            templates
+            + np.roll(templates, 1, axis=axis)
+            + np.roll(templates, -1, axis=axis)
+        ) / 3.0
     noise_rng = np.random.RandomState(seed + (1 if train else 2))
     y = np.repeat(np.arange(nb_classes, dtype=np.int64), per_class)
-    noise = noise_rng.normal(0.0, 48.0, size=(len(y), input_size, input_size, channels))
+    noise = noise_rng.normal(
+        0.0, noise_std, size=(len(y), input_size, input_size, channels)
+    )
     x = np.clip(templates[y] + noise, 0, 255).astype(np.uint8)
     perm = np.random.RandomState(seed + 3).permutation(len(y))
     return x[perm], y[perm]
@@ -196,6 +214,11 @@ def build_raw_dataset(
         x, y = load_cifar100(data_path, train)
     elif name == "synthetic":
         x, y = load_synthetic(train=train)
+    elif name == "synthetic_hard":
+        # Protocol-evidence variant: heavy pixel noise keeps a small CNN off
+        # the 100% ceiling so the incremental trajectory (forgetting, WA
+        # recovery) is visible in RESULTS.md, not saturated away.
+        x, y = load_synthetic(train=train, noise_std=160.0)
     elif name.startswith("synthetic"):  # e.g. synthetic20 for smoke runs
         x, y = load_synthetic(nb_classes=int(name[len("synthetic"):]), train=train)
     elif name == "imagenet1000":

@@ -20,7 +20,7 @@ from typing import Optional, Sequence
 
 from .config import config_from_args, get_args_parser
 from .engine import CilTrainer
-from .utils.platform import force_platform
+from .utils.platform import enable_compile_cache, force_platform
 
 
 def main(argv: Optional[Sequence[str]] = None) -> dict:
@@ -35,6 +35,14 @@ def main(argv: Optional[Sequence[str]] = None) -> dict:
         force_platform(args.platform, args.host_devices)
     elif args.host_devices:
         parser.error("--host_devices requires --platform cpu")
+    if args.compile_cache:
+        import jax
+
+        # Respect a cache the embedding process already configured (e.g. the
+        # test suite's tests/.jax_cache via conftest) — the CLI default only
+        # fills the gap when none is set.
+        if jax.config.jax_compilation_cache_dir is None:
+            enable_compile_cache(args.compile_cache)
     config = config_from_args(args)
     trainer = CilTrainer(config)
     return trainer.fit()

@@ -20,6 +20,27 @@ import re
 from typing import Optional
 
 
+def enable_compile_cache(cache_dir: str) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    The protocol runs recompile the fused-epoch program once per distinct
+    task-dataset length (engine/train.make_epoch_fn); on TPU that is most of
+    a short run's wall-clock.  The cache makes every re-run (and every
+    repeated task shape) skip XLA entirely.  XLA's extra AOT kernel caches
+    stay off — their machine-feature check is brittle across hosts (see
+    tests/conftest.py).
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.expanduser(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except AttributeError:  # older jax without the sub-knob
+        pass
+
+
 def force_platform(
     platform: str,
     host_devices: int = 0,
@@ -58,13 +79,7 @@ def force_platform(
         pass  # too late — diagnosed by the post-check below
 
     if compile_cache_dir is not None:
-        jax.config.update("jax_compilation_cache_dir", compile_cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        try:
-            jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
-        except AttributeError:  # older jax without the sub-knob
-            pass
+        enable_compile_cache(compile_cache_dir)
 
     devs = jax.devices()
     actual = devs[0].platform if devs else "none"

@@ -19,13 +19,27 @@ def load(path: str):
     tasks, final, meta = [], None, {}
     with open(path) as f:
         for line in f:
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                # A killed run can leave a truncated trailing line; render
+                # what completed instead of aborting the whole report.
+                continue
             if rec.get("type") == "task":
                 tasks.append(rec)
             elif rec.get("type") == "final":
                 final = rec
             elif rec.get("type") == "run":
                 meta = rec
+            elif rec.get("type") == "resume":
+                # Segment marker contract (engine/loop.py): a crash between a
+                # task's records and its checkpoint replays that task — drop
+                # pre-resume records the resumed run re-emits.  A marker
+                # without start_task keeps everything (fail open, not empty).
+                start = rec.get("start_task")
+                if start is not None:
+                    tasks = [t for t in tasks if t.get("task_id", 0) < start]
+                    final = None
     return tasks, final, meta
 
 

@@ -128,4 +128,7 @@ def test_jsonl_experiment_log(devices8, tmp_path):
     task_records = [r for r in records if r["type"] == "task"]
     assert task_records[0]["gamma"] is None  # WA gated off for task 0
     assert task_records[1]["gamma"] is not None
-    assert "acc1" in records[0] and "loss" in records[0]
+    assert types[0] == "run"  # provenance header leads the file
+    assert records[0]["backbone"] == "resnet20"
+    first_epoch = next(r for r in records if r["type"] == "epoch")
+    assert "acc1" in first_epoch and "loss" in first_epoch
