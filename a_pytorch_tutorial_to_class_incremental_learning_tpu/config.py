@@ -35,6 +35,8 @@ CIFAR_MEAN = (0.5071, 0.4867, 0.4408)
 CIFAR_STD = (0.2675, 0.2565, 0.2761)
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
+MNIST_MEAN = (0.1307,)  # torchvision's standard 1-channel stats
+MNIST_STD = (0.3081,)
 
 
 def compute_increments(
@@ -159,6 +161,8 @@ class CilConfig:
         """
         if self.data_set == "CIFAR" and self.input_size == 32:
             return CIFAR_MEAN, CIFAR_STD
+        if "mnist" in self.data_set.lower():
+            return MNIST_MEAN, MNIST_STD
         return IMAGENET_MEAN, IMAGENET_STD
 
     def replace(self, **kw) -> "CilConfig":

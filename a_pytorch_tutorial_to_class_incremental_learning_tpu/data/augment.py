@@ -51,6 +51,7 @@ class AugmentConfig:
 
     input_size: int = 32
     crop_padding: int = 4
+    hflip: bool = True  # off for digit datasets (mirroring is label noise)
     rand_augment: bool = True
     ra_num_ops: int = 2
     ra_magnitude: float = 9.0
@@ -73,6 +74,9 @@ class AugmentConfig:
             # (datasets.decode_image_batch); the padded 4-pixel crop is the
             # <=32px replacement (reference utils.py:227-229).
             crop_padding=4 if config.input_size <= 32 else 0,
+            # Standard MNIST recipes never mirror: asymmetric digits
+            # (2,3,4,5,7,9) make horizontal flip structured label noise.
+            hflip="mnist" not in config.data_set.lower(),
             rand_augment=ra is not None,
             ra_magnitude=ra["m"] if ra else 9.0,
             ra_num_ops=ra["n"] if ra else 2,
@@ -230,7 +234,9 @@ def _translate_y(img: jax.Array, pixels: jax.Array) -> jax.Array:
 
 
 def _grayscale(img: jax.Array) -> jax.Array:
-    """ITU-R 601-2 luma, PIL ``convert('L')`` weights."""
+    """ITU-R 601-2 luma, PIL ``convert('L')`` weights; identity on 1-channel."""
+    if img.shape[-1] == 1:
+        return img
     w = jnp.array([0.299, 0.587, 0.114], img.dtype)
     return jnp.round((img * w).sum(-1, keepdims=True))
 
@@ -502,7 +508,8 @@ def _augment_one(key: jax.Array, img_u8: jax.Array, cfg: AugmentConfig) -> jax.A
     kcrop, kflip, kra, kerase = jax.random.split(key, 4)
     if cfg.crop_padding > 0:
         img = _random_crop(kcrop, img, cfg.crop_padding)
-    img = _random_flip(kflip, img)
+    if cfg.hflip:
+        img = _random_flip(kflip, img)
     if cfg.rand_augment:
         img = _rand_augment(kra, img, cfg)
     elif cfg.color_jitter > 0:

@@ -62,6 +62,51 @@ def _decode_cifar(raw: dict) -> Arrays:
     return np.ascontiguousarray(x), y
 
 
+def load_mnist_idx(data_path: str, train: bool) -> Arrays:
+    """Parse the standard MNIST IDX distribution (``train-images-idx3-ubyte``
+    etc., plain or ``.gz``) into ``(x uint8 [N,28,28,1], y int64)``.
+
+    Counterpart of continuum's ``MNIST`` dataset for the reference's
+    1-channel backbone factories (``resnet.py:127-139``) minus the network
+    download.  The IDX format: big-endian int32 magic (0x803 images /
+    0x801 labels), dims, then raw bytes.
+    """
+    import gzip
+    import struct
+
+    prefix = "train" if train else "t10k"
+
+    def read(kind: str, magic_want: int) -> np.ndarray:
+        names = [f"{prefix}-{kind}", f"{prefix}-{kind}.gz"]
+        roots = [data_path, os.path.join(data_path, "MNIST", "raw")]
+        for root in roots:
+            for name in names:
+                path = os.path.join(root, name)
+                if not os.path.isfile(path):
+                    continue
+                opener = gzip.open if path.endswith(".gz") else open
+                with opener(path, "rb") as f:
+                    magic, n = struct.unpack(">ii", f.read(8))
+                    if magic != magic_want:
+                        raise ValueError(f"{path}: bad IDX magic {magic:#x}")
+                    if magic_want == 0x803:
+                        h, w = struct.unpack(">ii", f.read(8))
+                        data = np.frombuffer(f.read(), np.uint8)
+                        return data.reshape(n, h, w, 1)
+                    return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        raise FileNotFoundError(
+            f"MNIST IDX files not found under {data_path!r} (no auto-download "
+            "in a zero-egress environment); use --data_set synthetic_mnist "
+            "for smoke runs"
+        )
+
+    x = read("images-idx3-ubyte", 0x803)
+    y = read("labels-idx1-ubyte", 0x801)
+    if len(x) != len(y):
+        raise ValueError(f"MNIST images/labels length mismatch: {len(x)}/{len(y)}")
+    return x, y
+
+
 def load_synthetic(
     nb_classes: int = 100,
     per_class: int = 64,
@@ -212,6 +257,13 @@ def build_raw_dataset(
     name = data_set.lower()
     if name == "cifar":
         x, y = load_cifar100(data_path, train)
+    elif name == "mnist":
+        x, y = load_mnist_idx(data_path, train)
+    elif name == "synthetic_mnist":
+        # 1-channel smoke dataset for the mnist backbone family.
+        x, y = load_synthetic(
+            nb_classes=10, input_size=input_size, channels=1, train=train
+        )
     elif name == "synthetic":
         x, y = load_synthetic(train=train)
     elif name == "synthetic_hard":

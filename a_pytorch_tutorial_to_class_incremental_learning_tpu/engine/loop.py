@@ -77,14 +77,46 @@ class CilTrainer:
         self.scenario_val, _ = build_scenario(config, train=False)
 
         dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
-        if "mnist" in config.backbone:
-            # The reference defines 1-channel backbone factories but its
-            # driver never dispatches them (template.py:72-84); no 1-channel
-            # dataset pipeline exists here either, so fail loudly.
-            raise NotImplementedError(
-                f"backbone {config.backbone!r}: 1-channel data pipeline not wired"
+        # 1-channel pipeline for the mnist backbone family — a family the
+        # reference defines but never dispatches (template.py:72-84,
+        # resnet.py:127-139); here it runs end-to-end (mnist/synthetic_mnist
+        # datasets, grayscale-aware augmentation, MNIST normalize stats).
+        channels = 1 if "mnist" in config.backbone else 3
+        data_x = self.scenario_train._x
+        lazy_paths = not (
+            isinstance(data_x, np.ndarray) and data_x.dtype != object
+        )
+        if lazy_paths:
+            # Lazy image-folder datasets decode to RGB (decode_image_batch).
+            if channels != 3:
+                raise ValueError(
+                    f"backbone {config.backbone!r} expects {channels}-channel "
+                    f"input but data_set {config.data_set!r} decodes to RGB"
+                )
+        else:
+            if data_x.shape[-1] != channels:
+                raise ValueError(
+                    f"backbone {config.backbone!r} expects {channels}-channel "
+                    f"input but data_set {config.data_set!r} has "
+                    f"{data_x.shape[-1]} channels"
+                )
+            if data_x.ndim == 4 and data_x.shape[1] != config.input_size:
+                raise ValueError(
+                    f"data_set {config.data_set!r} images are "
+                    f"{data_x.shape[1]}px but --input_size is "
+                    f"{config.input_size} — pass --input_size {data_x.shape[1]}"
+                )
+        from ..data.augment import parse_rand_augment
+
+        if channels == 1 and parse_rand_augment(config.aa) is not None:
+            # The RandAugment color/histogram ops are RGB-defined; crop/flip/
+            # jitter/erasing all handle 1 channel.  (aa may be the string
+            # 'none', which parse_rand_augment treats as off — raw truthiness
+            # of config.aa would reject it spuriously.)
+            raise ValueError(
+                f"backbone {config.backbone!r} is 1-channel; RandAugment "
+                "requires RGB — pass --aa none"
             )
-        channels = 3
         # Reference parity: batch_size is per-device (the reference's per-GPU
         # 128, DataLoader-per-rank under DistributedSampler); the global batch
         # scales with the data axis like DDP's world_size * 128.

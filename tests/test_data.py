@@ -325,3 +325,70 @@ def test_lazy_image_folder(tmp_path):
     s = ClassIncremental(paths, labels, initial_increment=0, increment=1)
     t0 = s[0]
     assert t0.x.dtype == object and len(t0) == 3
+
+
+def test_mnist_idx_loader(tmp_path):
+    import gzip
+    import struct
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.datasets import (
+        load_mnist_idx,
+    )
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (5, 28, 28), np.uint8)
+    labels = np.array([3, 1, 4, 1, 5], np.uint8)
+
+    img_blob = struct.pack(">iiii", 0x803, 5, 28, 28) + imgs.tobytes()
+    lbl_blob = struct.pack(">ii", 0x801, 5) + labels.tobytes()
+    # train split plain, t10k split gzipped — both container forms covered.
+    (tmp_path / "train-images-idx3-ubyte").write_bytes(img_blob)
+    (tmp_path / "train-labels-idx1-ubyte").write_bytes(lbl_blob)
+    (tmp_path / "t10k-images-idx3-ubyte.gz").write_bytes(gzip.compress(img_blob))
+    (tmp_path / "t10k-labels-idx1-ubyte.gz").write_bytes(gzip.compress(lbl_blob))
+
+    for train in (True, False):
+        x, y = load_mnist_idx(str(tmp_path), train=train)
+        assert x.shape == (5, 28, 28, 1) and x.dtype == np.uint8
+        np.testing.assert_array_equal(x[..., 0], imgs)
+        assert y.dtype == np.int64 and y.tolist() == [3, 1, 4, 1, 5]
+
+    with pytest.raises(FileNotFoundError):
+        load_mnist_idx(str(tmp_path / "nope"), train=True)
+
+
+def test_synthetic_mnist_is_one_channel():
+    (x, y), nb = build_raw_dataset("synthetic_mnist", "", train=True, input_size=28)
+    assert x.shape[1:] == (28, 28, 1) and nb == 10
+
+
+def test_one_channel_augment_shapes(devices8):
+    import jax
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+        AugmentConfig,
+        eval_preprocess,
+        train_augment,
+    )
+
+    cfg = AugmentConfig(
+        input_size=28, rand_augment=False, color_jitter=0.4, reprob=0.5,
+        hflip=False, mean=(0.1307,), std=(0.3081,),
+    )
+    x = np.random.RandomState(0).randint(0, 256, (4, 28, 28, 1), np.uint8)
+    out = train_augment(jax.random.PRNGKey(0), x, cfg)
+    assert out.shape == (4, 28, 28, 1) and np.isfinite(np.asarray(out)).all()
+    ev = eval_preprocess(x, cfg)
+    assert ev.shape == (4, 28, 28, 1)
+
+    # hflip=False (digit datasets): with every other op off, train_augment
+    # reduces exactly to normalization — nothing mirrors the digits.
+    plain = AugmentConfig(
+        input_size=28, crop_padding=0, rand_augment=False, color_jitter=0.0,
+        reprob=0.0, hflip=False, mean=(0.1307,), std=(0.3081,),
+    )
+    np.testing.assert_allclose(
+        np.asarray(train_augment(jax.random.PRNGKey(1), x, plain)),
+        np.asarray(eval_preprocess(x, plain)),
+        rtol=1e-6,
+    )

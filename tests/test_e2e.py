@@ -192,3 +192,60 @@ def test_image_folder_end_to_end(devices8, tmp_path):
     mx, _my, _mt = trainer.memory.get()
     assert mx.dtype == object and str(mx[0]).endswith(".png")
     assert result["acc1s"][0] > 30.0  # 2 classes, mean-color separable
+
+
+def test_channel_and_size_guards(devices8, tmp_path):
+    """Misconfigurations fail loudly at trainer init, before any compile."""
+    with pytest.raises(ValueError, match="RandAugment"):
+        CilTrainer(
+            _smoke_config(data_set="synthetic_mnist", backbone="resnet20mnist",
+                          input_size=28, increment=5,
+                          aa="rand-m9-mstd0.5-inc1"),
+            mesh=make_mesh((8, 1)), init_dist=False,
+        )
+    with pytest.raises(ValueError, match="channel"):
+        CilTrainer(  # 3-channel synthetic10 data into a 1-channel backbone
+            _smoke_config(backbone="resnet20mnist"),
+            mesh=make_mesh((8, 1)), init_dist=False,
+        )
+    # Real 28px IDX data with the default input_size=32 must be rejected.
+    import gzip
+    import struct
+
+    rng = np.random.RandomState(0)
+    img_blob = struct.pack(">iiii", 0x803, 20, 28, 28) + rng.randint(
+        0, 256, (20, 28, 28), np.uint8
+    ).tobytes()
+    lbl_blob = struct.pack(">ii", 0x801, 20) + (
+        np.arange(20, dtype=np.uint8) % 10
+    ).tobytes()
+    for prefix in ("train", "t10k"):
+        (tmp_path / f"{prefix}-images-idx3-ubyte.gz").write_bytes(
+            gzip.compress(img_blob)
+        )
+        (tmp_path / f"{prefix}-labels-idx1-ubyte.gz").write_bytes(
+            gzip.compress(lbl_blob)
+        )
+    with pytest.raises(ValueError, match="input_size"):
+        CilTrainer(
+            _smoke_config(data_set="mnist", data_path=str(tmp_path),
+                          backbone="resnet20mnist", increment=5),
+            mesh=make_mesh((8, 1)), init_dist=False,
+        )
+
+
+@pytest.mark.heavy
+def test_mnist_family_end_to_end(devices8):
+    """The reference defines 1-channel mnist backbones but never wires them
+    (reference template.py:72-84, resnet.py:127-139); here the family runs
+    the full 2-task protocol: 28px 1-channel data, grayscale jitter, MNIST
+    normalize stats."""
+    cfg = _smoke_config(
+        data_set="synthetic_mnist", backbone="resnet20mnist", input_size=28,
+        increment=5,
+    )
+    trainer = CilTrainer(cfg, mesh=make_mesh((8, 1)), init_dist=False)
+    result = trainer.fit()
+    assert result["nb_tasks"] == 2
+    assert result["acc1s"][0] > 40.0
+    assert result["acc1s"][1] > 25.0
