@@ -134,6 +134,7 @@ class CilConfig:
 
     # Checkpointing
     ckpt_dir: Optional[str] = None
+    ckpt_backend: str = "pickle"  # "orbax": sharded tensorstore writes/restores
     resume: bool = False
 
     # Profiling (SURVEY.md §5: absent in the reference; near-free here)
@@ -223,6 +224,11 @@ def get_args_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_model", default=1, type=int,
                    help="model-axis size of the device mesh")
     p.add_argument("--ckpt_dir", default=None, type=str)
+    p.add_argument("--ckpt_backend", default=d.ckpt_backend,
+                   choices=["pickle", "orbax"],
+                   help="orbax: every process writes its own parameter "
+                   "shards via tensorstore; restore places arrays directly "
+                   "onto the mesh sharding (no host gather)")
     p.add_argument("--resume", action="store_true", default=False)
     p.add_argument("--profile_dir", default=None, type=str,
                    help="write a jax.profiler trace of each task's first epoch")
@@ -295,6 +301,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         use_pallas_loss=args.use_pallas_loss,
         fused_epochs=args.fused_epochs,
         ckpt_dir=args.ckpt_dir,
+        ckpt_backend=args.ckpt_backend,
         resume=args.resume,
         profile_dir=args.profile_dir,
         log_file=args.log_file,

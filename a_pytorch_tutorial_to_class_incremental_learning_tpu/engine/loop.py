@@ -106,17 +106,17 @@ class CilTrainer:
                     f"{data_x.shape[1]}px but --input_size is "
                     f"{config.input_size} — pass --input_size {data_x.shape[1]}"
                 )
-        from ..data.augment import parse_rand_augment
-
-        if channels == 1 and parse_rand_augment(config.aa) is not None:
+        self.aug_cfg = AugmentConfig.from_config(config)
+        if channels == 1 and self.aug_cfg.rand_augment:
             # The RandAugment color/histogram ops are RGB-defined; crop/flip/
-            # jitter/erasing all handle 1 channel.  (aa may be the string
-            # 'none', which parse_rand_augment treats as off — raw truthiness
-            # of config.aa would reject it spuriously.)
+            # jitter/erasing all handle 1 channel.
             raise ValueError(
                 f"backbone {config.backbone!r} is 1-channel; RandAugment "
                 "requires RGB — pass --aa none"
             )
+        if config.ckpt_backend == "orbax" and config.ckpt_dir:
+            # Fail before any compile, not after task 0's training run.
+            import orbax.checkpoint  # noqa: F401
         # Reference parity: batch_size is per-device (the reference's per-GPU
         # 128, DataLoader-per-rank under DistributedSampler); the global batch
         # scales with the data axis like DDP's world_size * 128.
@@ -169,7 +169,6 @@ class CilTrainer:
             nb_total_classes=self.nb_classes if config.fixed_memory else None,
             prefer_native=have_native,
         )
-        self.aug_cfg = AugmentConfig.from_config(config)
         # The Pallas loss runs interpreted on CPU (partitionable) and through
         # Mosaic on TPU; on a multi-device mesh the step builders wrap it in
         # shard_map (Mosaic kernels cannot be auto-partitioned by XLA).

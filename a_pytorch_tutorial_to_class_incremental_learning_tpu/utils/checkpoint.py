@@ -10,8 +10,16 @@ optimizer every task anyway (``template.py:246``), so task-boundary resume is
 exact: a killed-and-resumed run reproduces the uninterrupted run bit-for-bit
 (same PRNG folds, same shuffles, same memory).
 
-Format: one pickle per task of host numpy pytrees (atomic rename), written by
-process 0 only.
+Two on-disk formats (``--ckpt_backend``):
+
+* ``pickle`` (default): one pickle per task of host numpy pytrees (atomic
+  rename), written by process 0 only.  Fine while parameters are replicated.
+* ``orbax``: the array state (params + batch stats) goes through
+  orbax/tensorstore — every process writes its own shards, nothing gathers
+  to one host, and restore places arrays directly onto the mesh sharding.
+  Host-side metadata (rehearsal memory, accuracy history, bookkeeping) is a
+  small sidecar pickle written first; a checkpoint counts as complete only
+  when both the sidecar and orbax's atomically-finalized directory exist.
 """
 
 from __future__ import annotations
@@ -28,30 +36,62 @@ import numpy as np
 from ..parallel.dist import barrier, is_main_process
 
 
-def _task_path(ckpt_dir: str, task_id: int) -> str:
-    return os.path.join(ckpt_dir, f"task_{task_id:03d}.ckpt")
+def _task_path(ckpt_dir: str, task_id: int, backend: str = "pickle") -> str:
+    ext = "orbax" if backend == "orbax" else "ckpt"
+    return os.path.join(ckpt_dir, f"task_{task_id:03d}.{ext}")
 
 
 def _to_host(tree):
     return jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)), tree)
 
 
+def _metadata(trainer, task_id: int) -> dict:
+    return {
+        "task_id": task_id,
+        "known": trainer.known,  # already includes this task's classes
+        "acc1s": list(trainer.acc1s),
+        "memory_store": trainer.memory._store,
+        "config_seed": trainer.config.seed,
+    }
+
+
 def save_task_checkpoint(trainer, task_id: int) -> str:
     """Persist post-task state (called by ``CilTrainer.fit`` when
     ``ckpt_dir`` is set)."""
     ckpt_dir = trainer.config.ckpt_dir
-    path = _task_path(ckpt_dir, task_id)
-    if is_main_process():
+    backend = trainer.config.ckpt_backend
+    path = _task_path(ckpt_dir, task_id, backend)
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        if is_main_process():
+            os.makedirs(ckpt_dir, exist_ok=True)
+            # Sidecar first: resume requires sidecar AND the orbax dir, and
+            # orbax finalizes its directory atomically — so a crash between
+            # the two writes never yields a half-checkpoint that loads.
+            tmp = path + ".meta.tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(
+                    _metadata(trainer, task_id), f, protocol=pickle.HIGHEST_PROTOCOL
+                )
+            os.replace(tmp, path + ".meta")
+        barrier()
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(
+            os.path.abspath(path),
+            {
+                "params": trainer.state.params,
+                "batch_stats": trainer.state.batch_stats,
+            },
+            force=True,
+        )
+        ckptr.wait_until_finished()
+        ckptr.close()
+    elif is_main_process():
         os.makedirs(ckpt_dir, exist_ok=True)
-        payload = {
-            "task_id": task_id,
-            "known": trainer.known,  # already includes this task's classes
-            "acc1s": list(trainer.acc1s),
-            "params": _to_host(trainer.state.params),
-            "batch_stats": _to_host(trainer.state.batch_stats),
-            "memory_store": trainer.memory._store,
-            "config_seed": trainer.config.seed,
-        }
+        payload = _metadata(trainer, task_id)
+        payload["params"] = _to_host(trainer.state.params)
+        payload["batch_stats"] = _to_host(trainer.state.batch_stats)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -65,9 +105,14 @@ def latest_task_checkpoint(ckpt_dir: str) -> Optional[str]:
         return None
     best = None
     for name in os.listdir(ckpt_dir):
-        m = re.fullmatch(r"task_(\d+)\.ckpt", name)
-        if m and (best is None or int(m.group(1)) > best[0]):
-            best = (int(m.group(1)), os.path.join(ckpt_dir, name))
+        m = re.fullmatch(r"task_(\d+)\.(ckpt|orbax)", name)
+        if not m:
+            continue
+        path = os.path.join(ckpt_dir, name)
+        if m.group(2) == "orbax" and not os.path.exists(path + ".meta"):
+            continue  # incomplete: sidecar missing
+        if best is None or int(m.group(1)) > best[0]:
+            best = (int(m.group(1)), path)
     return best[1] if best else None
 
 
@@ -83,7 +128,7 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
     path = path or latest_task_checkpoint(trainer.config.ckpt_dir or "")
     found_task = -1
     if path and os.path.exists(path):
-        m = re.search(r"task_(\d+)\.ckpt$", path)
+        m = re.search(r"task_(\d+)\.(ckpt|orbax)$", path)
         found_task = int(m.group(1)) if m else -1
     # Multi-host: every process must agree on the resume point, or they would
     # run different programs and deadlock.  Fail loudly on disagreement
@@ -101,15 +146,38 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
             )
     if found_task < 0:
         return False
-    with open(path, "rb") as f:
-        payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint
+    if path.endswith(".orbax"):
+        import orbax.checkpoint as ocp
+
+        with open(path + ".meta", "rb") as f:
+            payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint
+    else:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint
     if payload["config_seed"] != trainer.config.seed:
         raise ValueError(
             f"checkpoint seed {payload['config_seed']} != config seed "
             f"{trainer.config.seed}; refusing silent mix of experiments"
         )
-    params = shard_params(trainer.mesh, payload["params"])
-    batch_stats = shard_params(trainer.mesh, payload["batch_stats"])
+    if path.endswith(".orbax"):
+        # Restore straight onto the mesh sharding: the static full-width head
+        # keeps every array's shape constant across tasks, so the live state
+        # is its own restore template — no host-side gather at any point.
+        template = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+            {
+                "params": trainer.state.params,
+                "batch_stats": trainer.state.batch_stats,
+            },
+        )
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path), template)
+        ckptr.close()
+        params = restored["params"]
+        batch_stats = restored["batch_stats"]
+    else:
+        params = shard_params(trainer.mesh, payload["params"])
+        batch_stats = shard_params(trainer.mesh, payload["batch_stats"])
     known = int(payload["known"])
     trainer.state = trainer.state.replace(
         params=params,

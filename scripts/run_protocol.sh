@@ -13,6 +13,7 @@ mkdir -p experiments
 
 EPOCHS=${EPOCHS:-25}
 SEED=${SEED:-0}
+BATCH=${BATCH:-128}  # per device: 128 on 1 real chip = the reference's per-GPU
 PLATFORM_ARGS=${PLATFORM_ARGS:-}
 AA=${AA:-None}  # RandAugment off by default: compile cost, see tests/test_augment.py
 # synthetic_hard: heavy-noise variant — accuracies stay off the 100% ceiling
@@ -20,11 +21,11 @@ AA=${AA:-None}  # RandAugment off by default: compile cost, see tests/test_augme
 DATASET=${DATASET:-synthetic_hard}
 
 python train.py --data_set "$DATASET" --num_bases 0 --increment 10 \
-  --backbone resnet32 --batch_size 128 --num_epochs "$EPOCHS" --aa "$AA" \
+  --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
   --seed "$SEED" $PLATFORM_ARGS --log_file "experiments/b0_inc10_${DATASET}.jsonl"
 
 python train.py --data_set "$DATASET" --num_bases 50 --increment 10 \
-  --backbone resnet32 --batch_size 128 --num_epochs "$EPOCHS" --aa "$AA" \
+  --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
   --seed "$SEED" $PLATFORM_ARGS --log_file "experiments/b50_inc10_${DATASET}.jsonl"
 
 python scripts/summarize_results.py \

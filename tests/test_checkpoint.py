@@ -36,19 +36,31 @@ def _cfg(**kw):
     return CilConfig(**defaults)
 
 
-def test_kill_and_resume_reproduces(devices8, tmp_path):
+@pytest.mark.parametrize("backend", ["pickle", "orbax"])
+def test_kill_and_resume_reproduces(devices8, tmp_path, backend):
+    import shutil
+
     mesh = make_mesh((8, 1))
     ckpt = str(tmp_path / "ckpts")
+    ext = "ckpt" if backend == "pickle" else "orbax"
 
     # Uninterrupted 2-task run (also writes per-task checkpoints).
-    full = CilTrainer(_cfg(ckpt_dir=ckpt), mesh=mesh, init_dist=False)
+    full = CilTrainer(
+        _cfg(ckpt_dir=ckpt, ckpt_backend=backend), mesh=mesh, init_dist=False
+    )
     ref = full.fit()
-    assert latest_task_checkpoint(ckpt).endswith("task_001.ckpt")
+    assert latest_task_checkpoint(ckpt).endswith(f"task_001.{ext}")
 
     # Simulate a crash after task 0: drop the task-1 checkpoint and resume.
-    os.remove(os.path.join(ckpt, "task_001.ckpt"))
+    if backend == "orbax":
+        shutil.rmtree(os.path.join(ckpt, "task_001.orbax"))
+        os.remove(os.path.join(ckpt, "task_001.orbax.meta"))
+    else:
+        os.remove(os.path.join(ckpt, "task_001.ckpt"))
     resumed = CilTrainer(
-        _cfg(ckpt_dir=ckpt, resume=True), mesh=mesh, init_dist=False
+        _cfg(ckpt_dir=ckpt, ckpt_backend=backend, resume=True),
+        mesh=mesh,
+        init_dist=False,
     )
     assert resumed.start_task == 1
     assert resumed.known == 5
@@ -86,3 +98,13 @@ def test_resume_without_checkpoint_is_fresh(devices8, tmp_path):
         init_dist=False,
     )
     assert t.start_task == 0 and t.known == 0
+
+
+def test_incomplete_orbax_checkpoint_ignored(tmp_path):
+    """An orbax dir without its metadata sidecar is not a resumable
+    checkpoint (crash window between the two writes)."""
+    d = tmp_path / "ck"
+    (d / "task_003.orbax").mkdir(parents=True)
+    assert latest_task_checkpoint(str(d)) is None
+    (d / "task_003.orbax.meta").write_bytes(b"x")
+    assert latest_task_checkpoint(str(d)).endswith("task_003.orbax")
