@@ -270,7 +270,7 @@ def build_raw_dataset(
         # Protocol-evidence variant: heavy pixel noise keeps a small CNN off
         # the 100% ceiling so the incremental trajectory (forgetting, WA
         # recovery) is visible in RESULTS.md, not saturated away.
-        x, y = load_synthetic(train=train, noise_std=160.0)
+        x, y = load_synthetic(train=train, noise_std=96.0)
     elif name.startswith("synthetic"):  # e.g. synthetic20 for smoke runs
         x, y = load_synthetic(nb_classes=int(name[len("synthetic"):]), train=train)
     elif name == "imagenet1000":

@@ -26,3 +26,63 @@ def task_trace(profile_dir: Optional[str], name: str) -> Iterator[None]:
 def annotate(name: str):
     """Named span inside an active trace (decorator/context manager)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def device_step_ms_from_xspaces(xspaces, n_steps: int) -> dict:
+    """Per-step device time from parsed XSpace protos.
+
+    Walks the ``/device:*`` planes (the TPU plane's module lines record
+    on-chip execution spans; XLA:CPU emits no device plane, in which case
+    this returns {} — "no witness", not agreement) and averages the longest
+    ``n_steps`` top-level jitted-module events (metadata names ``jit_*``),
+    so fence/metrics mini-programs don't dilute the number.  The independent
+    witness for slope-timed benchmarks (bench.py, scripts/profile_mfu.py).
+    """
+    import numpy as np
+
+    durs_ps = []
+    for xs in xspaces:
+        for plane in xs.planes:
+            if not plane.name.startswith("/device:"):
+                continue
+            md = {m.id: m.name for m in plane.event_metadata.values()}
+            for line in plane.lines:
+                for ev in line.events:
+                    if md.get(ev.metadata_id, "").startswith("jit_"):
+                        durs_ps.append(ev.duration_ps)
+    if not durs_ps:
+        return {}
+    durs_ps = sorted(durs_ps, reverse=True)[:n_steps]
+    return {
+        "trace_step_ms": round(float(np.sum(durs_ps)) / 1e9 / len(durs_ps), 3),
+        "trace_events_used": len(durs_ps),
+    }
+
+
+def trace_device_step_ms(trace_dir: str, n_steps: int) -> dict:
+    """Load every ``*.xplane.pb`` under ``trace_dir`` and derive per-step
+    device time.  Direct proto parsing — the tensorboard-plugin-profile
+    tool-data pipeline in this image predates the installed protobuf and
+    cannot import."""
+    import glob
+    import os
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+    if not paths:
+        return {}
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:  # pragma: no cover - tf absent in some images
+        return {}
+    xspaces = []
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        try:
+            with open(p, "rb") as f:
+                xs.ParseFromString(f.read())
+        except Exception as e:  # noqa: BLE001
+            return {"trace_parse_error": f"{type(e).__name__}: {e}"}
+        xspaces.append(xs)
+    return device_step_ms_from_xspaces(xspaces, n_steps)

@@ -132,3 +132,52 @@ def test_jsonl_experiment_log(devices8, tmp_path):
     assert records[0]["backbone"] == "resnet20"
     first_epoch = next(r for r in records if r["type"] == "epoch")
     assert "acc1" in first_epoch and "loss" in first_epoch
+
+
+def test_profile_mfu_xspace_parser():
+    """scripts/profile_mfu.py derives per-step device time from XSpace
+    protos: only /device:* planes count, only jit_* module events count,
+    and the longest n_steps spans are averaged (fence/metrics programs and
+    host python lanes must not dilute the number)."""
+    import importlib.util
+    import os as _os
+
+    pb2 = pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+
+    spec = importlib.util.spec_from_file_location(
+        "profile_mfu",
+        _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+                      "scripts", "profile_mfu.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    xs = pb2.XSpace()
+    dev = xs.planes.add(name="/device:TPU:0")
+    m1 = dev.event_metadata[1]
+    m1.id, m1.name = 1, "jit_step"
+    m2 = dev.event_metadata[2]
+    m2.id, m2.name = 2, "jit_fence_fetch"
+    m3 = dev.event_metadata[3]
+    m3.id, m3.name = 3, "infeed"
+    line = dev.lines.add(name="XLA Modules")
+    for dur_ms in (2.0, 2.0, 2.0):  # three real steps
+        e = line.events.add()
+        e.metadata_id, e.duration_ps = 1, int(dur_ms * 1e9)
+    e = line.events.add()
+    e.metadata_id, e.duration_ps = 2, int(0.01 * 1e9)  # tiny fence program
+    e = line.events.add()
+    e.metadata_id, e.duration_ps = 3, int(50 * 1e9)  # non-jit noise
+    host = xs.planes.add(name="/host:CPU")
+    hm = host.event_metadata[9]
+    hm.id, hm.name = 9, "jit_step"  # host-side dispatch span: must not count
+    hl = host.lines.add()
+    he = hl.events.add()
+    he.metadata_id, he.duration_ps = 9, int(100 * 1e9)
+
+    out = mod.device_step_ms_from_xspaces([xs], n_steps=3)
+    assert out["trace_events_used"] == 3
+    assert out["trace_step_ms"] == pytest.approx(2.0)
+
+    # No device plane (the XLA:CPU case) -> no witness, not a zero.
+    assert mod.device_step_ms_from_xspaces([pb2.XSpace()], 3) == {}
