@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Probe the tunneled TPU until it answers, then capture the round's real-chip
+# evidence: bench.py JSON (with the profiler-trace MFU witness) and
+# profile_mfu.py JSON into experiments/.  The axon tunnel wedges for hours at
+# a time (a killed client can wedge the chip); every probe runs in a killable
+# subprocess with a timeout so the watchdog itself never hangs.
+#
+#   nohup setsid ./scripts/tpu_watchdog.sh &   # survives the session
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p experiments
+
+INTERVAL=${INTERVAL:-600}
+while true; do
+  if timeout 90 python -c "
+import jax, numpy as np
+x = jax.numpy.ones((128, 128))
+assert jax.default_backend() == 'tpu', jax.default_backend()
+float(np.asarray((x @ x).sum()))
+print('tpu alive')
+" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) TPU alive — capturing bench + profiler witness"
+    timeout 1800 python bench.py > experiments/bench_tpu.json 2> /tmp/bench_tpu.err
+    timeout 900 python scripts/profile_mfu.py \
+      > experiments/profile_mfu_tpu.json 2> /tmp/profile_mfu_tpu.err
+    echo "$(date -u +%FT%TZ) captured:"
+    tail -1 experiments/bench_tpu.json || true
+    tail -1 experiments/profile_mfu_tpu.json || true
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) TPU unreachable; retry in ${INTERVAL}s"
+  sleep "$INTERVAL"
+done
