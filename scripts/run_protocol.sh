@@ -19,16 +19,18 @@ AA=${AA:-None}  # RandAugment off by default: compile cost, see tests/test_augme
 # synthetic_hard: heavy-noise variant — accuracies stay off the 100% ceiling
 # so forgetting and WA recovery are visible in the trajectory.
 DATASET=${DATASET:-synthetic_hard}
+SUFFIX=${SUFFIX:-}  # e.g. SUFFIX=_tpu140 to keep runs side by side
 
 python train.py --data_set "$DATASET" --num_bases 0 --increment 10 \
   --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
-  --seed "$SEED" $PLATFORM_ARGS --log_file "experiments/b0_inc10_${DATASET}.jsonl"
+  --seed "$SEED" $PLATFORM_ARGS \
+  --log_file "experiments/b0_inc10_${DATASET}${SUFFIX}.jsonl"
 
 python train.py --data_set "$DATASET" --num_bases 50 --increment 10 \
   --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
-  --seed "$SEED" $PLATFORM_ARGS --log_file "experiments/b50_inc10_${DATASET}.jsonl"
+  --seed "$SEED" $PLATFORM_ARGS \
+  --log_file "experiments/b50_inc10_${DATASET}${SUFFIX}.jsonl"
 
-python scripts/summarize_results.py \
-  "experiments/b0_inc10_${DATASET}.jsonl" \
-  "experiments/b50_inc10_${DATASET}.jsonl" > RESULTS.md
+# Render every committed-evidence log present, not just this invocation's.
+python scripts/summarize_results.py experiments/*.jsonl > RESULTS.md
 echo "wrote RESULTS.md"

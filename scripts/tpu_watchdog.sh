@@ -26,6 +26,12 @@ print('tpu alive')
     echo "$(date -u +%FT%TZ) captured:"
     tail -1 experiments/bench_tpu.json || true
     tail -1 experiments/profile_mfu_tpu.json || true
+    # Full-recipe protocol evidence on the real chip: 140 epochs (the
+    # reference's code default) is minutes on TPU vs hours on CPU.
+    echo "$(date -u +%FT%TZ) starting 140-epoch TPU protocol runs"
+    EPOCHS=140 SUFFIX=_tpu140 timeout 10800 bash scripts/run_protocol.sh \
+      > /tmp/protocol_tpu.log 2>&1 || echo "TPU protocol rc=$?"
+    echo "$(date -u +%FT%TZ) watchdog done"
     exit 0
   fi
   echo "$(date -u +%FT%TZ) TPU unreachable; retry in ${INTERVAL}s"
