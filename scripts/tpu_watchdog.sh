@@ -12,7 +12,7 @@ mkdir -p experiments
 
 INTERVAL=${INTERVAL:-600}
 while true; do
-  if timeout 90 python -c "
+  if timeout -k 10 90 python -c "
 import jax, numpy as np
 x = jax.numpy.ones((128, 128))
 assert jax.default_backend() == 'tpu', jax.default_backend()
