@@ -97,6 +97,9 @@ class CilConfig:
     remode: str = "pixel"
     recount: int = 1
     resplit: bool = False          # parsed but dead in the reference too
+    ra_interpolation: str = "bilinear"  # geometric RandAugment resampling:
+    # "bilinear" (branch-free device default) | "bicubic" | "random" = timm
+    # 0.5.4 parity (each applied op picks bilinear/bicubic at random)
 
     # Rehearsal memory
     herding_method: str = "barycenter"
@@ -194,6 +197,10 @@ def get_args_parser() -> argparse.ArgumentParser:
     p.add_argument("--recount", default=d.recount, type=int,
                    help="Random erase count")
     p.add_argument("--resplit", action="store_true", default=False)
+    p.add_argument("--ra_interpolation", default=d.ra_interpolation, type=str,
+                   choices=("bilinear", "bicubic", "random"),
+                   help="geometric RandAugment resampling; 'random' = timm "
+                   "0.5.4 parity (per-op bilinear/bicubic choice)")
     p.add_argument("--herding_method", default=d.herding_method, type=str)
     p.add_argument("--memory_size", default=d.memory_size, type=int)
     p.add_argument("--fixed_memory", action="store_true", default=False)
@@ -286,6 +293,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         remode=args.remode,
         recount=args.recount,
         resplit=args.resplit,
+        ra_interpolation=args.ra_interpolation,
         herding_method=args.herding_method,
         memory_size=args.memory_size,
         fixed_memory=args.fixed_memory,

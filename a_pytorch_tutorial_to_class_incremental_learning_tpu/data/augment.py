@@ -19,8 +19,11 @@ Pipeline fidelity (timm 0.5.4 semantics, ``rand-m9-mstd0.5-inc1`` default):
   Color, Contrast, Brightness, Sharpness, ShearX, ShearY, TranslateXRel,
   TranslateYRel) with the "increasing" magnitude maps, magnitude ~
   N(9, 0.5) clipped to [0, 10], random sign for signed ops, fill 128 for
-  geometric ops.  Geometric resampling is bilinear (timm randomly picks
-  bilinear/bicubic; a fixed kernel keeps the op branch-free on device).
+  geometric ops.  Geometric resampling follows ``ra_interpolation``:
+  ``"bilinear"`` (default — one fixed kernel keeps the warp single-pass on
+  device), ``"bicubic"``, or ``"random"`` = timm 0.5.4 parity (each applied
+  geometric op independently picks bilinear or bicubic, timm's
+  ``_RANDOM_INTERPOLATION``; costs a second warp pass under vmap).
 * ``Normalize``: ``(x/255 - mean) / std`` with the stats chosen by
   ``CilConfig.normalization_stats()`` (preserving the reference's
   CIFAR-vs-ImageNet quirk, ``utils.py:231-233``).
@@ -57,6 +60,9 @@ class AugmentConfig:
     ra_magnitude: float = 9.0
     ra_mag_std: float = 0.5
     ra_prob: float = 0.5  # per-op apply probability (timm AugmentOp default)
+    # Geometric-op resampling: "bilinear" | "bicubic" | "random" (timm parity:
+    # each applied op picks one of the two at random).
+    ra_interpolation: str = "bilinear"
     color_jitter: float = 0.4  # used only when rand_augment is False
     reprob: float = 0.0
     remode: str = "pixel"  # timm modes: pixel | rand | const
@@ -82,6 +88,7 @@ class AugmentConfig:
             ra_num_ops=ra["n"] if ra else 2,
             ra_mag_std=ra["mstd"] if ra else 0.5,
             ra_prob=ra["p"] if ra else 0.5,
+            ra_interpolation=getattr(config, "ra_interpolation", "bilinear"),
             color_jitter=config.color_jitter or 0.0,
             reprob=config.reprob,
             remode=config.remode,
@@ -146,9 +153,28 @@ def _round_u8(img: jax.Array) -> jax.Array:
 # --------------------------------------------------------------------------- #
 
 
-def _affine(img: jax.Array, mat: jax.Array) -> jax.Array:
-    """Apply a 2x3 affine map (output pixel -> input pixel), bilinear, FILL
-    outside.  ``img`` is [H, W, C] float in [0, 255]."""
+def _cubic_weight(t: jax.Array) -> jax.Array:
+    """Keys cubic-convolution kernel with a = -1.0.
+
+    PIL has two different bicubics: Resample.c (resize) uses a = -0.5, but
+    Geometry.c — the transform/rotate path every timm geometric AugmentOp
+    goes through — uses the a = -1 cubic (its BICUBIC macro's polynomial
+    form expands to exactly this kernel; verified to max-1/255 against
+    ``Image.rotate(resample=BICUBIC)`` in tests/test_augment.py)."""
+    a = -1.0
+    at = jnp.abs(t)
+    near = ((a + 2.0) * at - (a + 3.0)) * at * at + 1.0
+    far = a * (((at - 5.0) * at + 8.0) * at - 4.0)
+    return jnp.where(at <= 1.0, near, jnp.where(at < 2.0, far, 0.0))
+
+
+def _affine(img: jax.Array, mat: jax.Array, kernel: str = "bilinear") -> jax.Array:
+    """Apply a 2x3 affine map (output pixel -> input pixel), FILL outside.
+    ``img`` is [H, W, C] float in [0, 255]; ``kernel`` is ``"bilinear"``
+    (4-tap) or ``"bicubic"`` (16-tap Keys a=-1, PIL Geometry.c's filter —
+    see ``_cubic_weight``).  Out-of-image taps contribute FILL (both
+    kernels' weights sum to 1, so fully-outside output pixels are exactly
+    FILL)."""
     h, w = img.shape[0], img.shape[1]
     ys, xs = jnp.meshgrid(
         jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32),
@@ -168,12 +194,21 @@ def _affine(img: jax.Array, mat: jax.Array) -> jax.Array:
         px = img[yi_c, xi_c]
         return jnp.where(valid[..., None], px, FILL)
 
-    out = (
-        sample(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
-        + sample(y0, x0 + 1) * (wx * (1 - wy))[..., None]
-        + sample(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
-        + sample(y0 + 1, x0 + 1) * (wx * wy)[..., None]
-    )
+    if kernel == "bilinear":
+        return (
+            sample(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+            + sample(y0, x0 + 1) * (wx * (1 - wy))[..., None]
+            + sample(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
+            + sample(y0 + 1, x0 + 1) * (wx * wy)[..., None]
+        )
+    if kernel != "bicubic":
+        raise ValueError(f"unknown resampling kernel {kernel!r}")
+    out = jnp.zeros_like(img)
+    for dy in (-1, 0, 1, 2):
+        wyv = _cubic_weight(wy - dy)
+        for dx in (-1, 0, 1, 2):
+            wxv = _cubic_weight(wx - dx)
+            out = out + sample(y0 + dy, x0 + dx) * (wxv * wyv)[..., None]
     return out
 
 
@@ -369,15 +404,27 @@ def _geom_matrix(img_shape, op_idx: jax.Array, frac: jax.Array,
 
 
 def _ra_apply(img: jax.Array, op_idx: jax.Array, magnitude: jax.Array,
-              sign: jax.Array, size: int) -> jax.Array:
-    """Apply op ``op_idx`` at ``magnitude`` (in [0, 10]); ``sign`` is ±1."""
+              sign: jax.Array, size: int, interpolation: str = "bilinear",
+              use_bicubic: Optional[jax.Array] = None) -> jax.Array:
+    """Apply op ``op_idx`` at ``magnitude`` (in [0, 10]); ``sign`` is ±1.
+
+    ``interpolation`` picks the geometric resampling kernel; for ``"random"``
+    the traced bool ``use_bicubic`` selects per application (timm parity).
+    """
     frac = magnitude / 10.0
 
-    # ONE bilinear warp shared by all five geometric branches (the matrix is
+    # ONE warp shared by all five geometric branches (the matrix is
     # op-selected, identity resamples exactly); grayscale shared by
     # color/contrast.  The remaining switch branches are cheap elementwise
-    # passes, so compute-all-and-select stays cheap.
-    warped = _affine(img, _geom_matrix(img.shape, op_idx, frac, sign, size))
+    # passes, so compute-all-and-select stays cheap.  "random" interpolation
+    # pays a second warp pass — the documented cost of exact timm parity.
+    mat = _geom_matrix(img.shape, op_idx, frac, sign, size)
+    if interpolation == "random":
+        warped = jnp.where(
+            use_bicubic, _affine(img, mat, "bicubic"), _affine(img, mat, "bilinear")
+        )
+    else:
+        warped = _affine(img, mat, interpolation)
     gray = _grayscale(img)
 
     branches = [
@@ -407,7 +454,13 @@ NUM_RA_OPS = 15
 
 def _rand_augment(key: jax.Array, img: jax.Array, cfg: AugmentConfig) -> jax.Array:
     for i in range(cfg.ra_num_ops):
+        # The 5-way split is the round-3 stream; the parity mode's extra
+        # interpolation key is derived by fold_in so enabling it does not
+        # perturb the op/magnitude/sign/apply draws of committed evidence.
         kop, kmag, ksign, kprob, key = jax.random.split(jax.random.fold_in(key, i), 5)
+        use_bicubic = None
+        if cfg.ra_interpolation == "random":
+            use_bicubic = jax.random.bernoulli(jax.random.fold_in(kprob, 1))
         op_idx = jax.random.randint(kop, (), 0, NUM_RA_OPS)
         mag = jnp.clip(
             cfg.ra_magnitude + cfg.ra_mag_std * jax.random.normal(kmag),
@@ -417,7 +470,11 @@ def _rand_augment(key: jax.Array, img: jax.Array, cfg: AugmentConfig) -> jax.Arr
         sign = jnp.where(jax.random.bernoulli(ksign), 1.0, -1.0)
         # timm builds every rand AugmentOp with prob=0.5: a chosen op is
         # applied only half the time, so "n2" averages ~1 op per image.
-        applied = _ra_apply(img, op_idx, mag, sign, cfg.input_size)
+        applied = _ra_apply(
+            img, op_idx, mag, sign, cfg.input_size,
+            interpolation=cfg.ra_interpolation,
+            use_bicubic=use_bicubic,
+        )
         img = jnp.where(jax.random.bernoulli(kprob, cfg.ra_prob), applied, img)
     return img
 

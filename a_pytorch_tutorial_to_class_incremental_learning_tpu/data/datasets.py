@@ -266,11 +266,14 @@ def build_raw_dataset(
         )
     elif name == "synthetic":
         x, y = load_synthetic(train=train)
-    elif name == "synthetic_hard":
+    elif name.startswith("synthetic_hard"):
         # Protocol-evidence variant: heavy pixel noise keeps a small CNN off
         # the 100% ceiling so the incremental trajectory (forgetting, WA
-        # recovery) is visible in RESULTS.md, not saturated away.
-        x, y = load_synthetic(train=train, noise_std=96.0)
+        # recovery) is visible in RESULTS.md, not saturated away.  A numeric
+        # suffix sets the noise std directly (e.g. synthetic_hard128);
+        # bare "synthetic_hard" keeps the round-3 level of 96.
+        std = float(name[len("synthetic_hard"):] or 96.0)
+        x, y = load_synthetic(train=train, noise_std=std)
     elif name.startswith("synthetic"):  # e.g. synthetic20 for smoke runs
         x, y = load_synthetic(nb_classes=int(name[len("synthetic"):]), train=train)
     elif name == "imagenet1000":

@@ -34,6 +34,19 @@ def _epoch_perm(seed: int, n: int) -> np.ndarray:
     return np.random.RandomState(seed & 0x7FFFFFFF).permutation(n)
 
 
+def _per_process(batch_size: int, process_count: int) -> int:
+    """Per-process stripe width.  A loud raise, not an ``assert``: these guard
+    multi-process sharding and must survive ``python -O`` — an indivisible
+    global batch would silently mis-shard otherwise."""
+    per_proc, rem = divmod(batch_size, process_count)
+    if rem:
+        raise ValueError(
+            f"global batch_size {batch_size} is not divisible by "
+            f"process_count {process_count}"
+        )
+    return per_proc
+
+
 def train_batches(
     task: TaskSet,
     batch_size: int,
@@ -50,10 +63,7 @@ def train_batches(
     perm = _epoch_perm(seed, n)
     nb_batches = max(1, -(-n // batch_size))  # ceil; wrap-pad the tail
     padded = np.resize(perm, nb_batches * batch_size)
-    per_proc = batch_size // process_count
-    assert per_proc * process_count == batch_size, (
-        f"batch_size {batch_size} not divisible by process_count {process_count}"
-    )
+    per_proc = _per_process(batch_size, process_count)
     for b in range(nb_batches):
         idx = padded[b * batch_size : (b + 1) * batch_size]
         idx = idx[process_index * per_proc : (process_index + 1) * per_proc]
@@ -68,8 +78,7 @@ def eval_batches(
 ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Sequential ``(x, y, weight)`` batches; padding rows carry weight 0."""
     n = len(task)
-    per_proc = batch_size // process_count
-    assert per_proc * process_count == batch_size
+    per_proc = _per_process(batch_size, process_count)
     nb_batches = -(-n // batch_size)
     for b in range(nb_batches):
         idx = np.arange(b * batch_size, (b + 1) * batch_size)

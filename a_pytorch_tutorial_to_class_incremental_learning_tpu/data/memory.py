@@ -83,26 +83,62 @@ def herd_random(features: np.ndarray, nb: int, seed: int = 0) -> np.ndarray:
 
 def herd_cluster(features: np.ndarray, nb: int, iters: int = 20) -> np.ndarray:
     """K-means the class features into ``nb`` clusters, keep the sample nearest
-    each centroid (continuum's "cluster" method)."""
+    each centroid (one diverse representative per cluster).
+
+    Returned indices are in **rank order** like every herding method here —
+    clusters are visited in descending population, so when
+    ``RehearsalMemory.add``'s quota shrink truncates the stored prefix it
+    keeps the representatives of the most-populated (highest-mass) clusters,
+    not an arbitrary init-permutation subset.
+
+    Deterministic: fixed init seed, Lloyd iterations, stable per-centroid
+    nearest-unchosen assignment.  **Parity caveat**: continuum 1.2.2's
+    ``"cluster"`` herding could not be byte-verified in this zero-egress
+    environment (continuum is not installed here); this is a documented
+    approximation of its clustering selection, covered by golden/property
+    tests instead of a library-diff.  The default recipe uses
+    ``barycenter`` (reference ``template.py:214``), which *is* golden- and
+    C++-parity-tested, so this method never touches default-parity runs.
+    """
+    features = np.asarray(features, np.float64)
     n = len(features)
     nb = min(nb, n)
     rng = np.random.RandomState(0)
     centroids = features[rng.permutation(n)[:nb]].copy()
+
+    def sq_dists(c: np.ndarray) -> np.ndarray:
+        # ||x||^2 + ||c||^2 - 2 x.c -> [n, nb] without an [n, nb, d] temporary
+        # (quota 2000 x a few thousand candidates would be GBs otherwise).
+        d2 = (
+            (features * features).sum(1)[:, None]
+            + (c * c).sum(1)[None, :]
+            - 2.0 * features @ c.T
+        )
+        return np.maximum(d2, 0.0)
+
     for _ in range(iters):
-        d = np.linalg.norm(features[:, None, :] - centroids[None, :, :], axis=2)
-        assign = d.argmin(axis=1)
+        assign = sq_dists(centroids).argmin(axis=1)
         for c in range(nb):
             members = features[assign == c]
             if len(members):
                 centroids[c] = members.mean(axis=0)
-    d = np.linalg.norm(features[:, None, :] - centroids[None, :, :], axis=2)
-    chosen: list[int] = []
-    for c in range(nb):
-        for i in np.argsort(d[:, c]):
-            if i not in chosen:
-                chosen.append(int(i))
+    # Per centroid, the nearest not-yet-chosen sample; boolean mask instead
+    # of the O(n * nb) `in list` scan.  Centroids are visited in descending
+    # population so the output prefix covers the densest clusters first (the
+    # rank-order contract add()'s quota truncation relies on).
+    d2 = sq_dists(centroids)
+    assign = d2.argmin(axis=1)
+    pop = np.bincount(assign, minlength=nb)
+    order = np.argsort(d2, axis=0, kind="stable")
+    taken = np.zeros(n, bool)
+    chosen = np.empty(nb, np.int64)
+    for rank, c in enumerate(np.argsort(-pop, kind="stable")):
+        for i in order[:, c]:
+            if not taken[i]:
+                chosen[rank] = i
+                taken[i] = True
                 break
-    return np.asarray(chosen, np.int64)
+    return chosen
 
 
 _METHODS: Dict[str, Callable[..., np.ndarray]] = {

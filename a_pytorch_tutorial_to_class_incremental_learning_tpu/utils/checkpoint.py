@@ -14,12 +14,15 @@ Two on-disk formats (``--ckpt_backend``):
 
 * ``pickle`` (default): one pickle per task of host numpy pytrees (atomic
   rename), written by process 0 only.  Fine while parameters are replicated.
-* ``orbax``: the array state (params + batch stats) goes through
-  orbax/tensorstore — every process writes its own shards, nothing gathers
-  to one host, and restore places arrays directly onto the mesh sharding.
-  Host-side metadata (rehearsal memory, accuracy history, bookkeeping) is a
-  small sidecar pickle written first; a checkpoint counts as complete only
-  when both the sidecar and orbax's atomically-finalized directory exist.
+* ``orbax``: the *device array* state (params + batch stats) goes through
+  orbax/tensorstore — every process writes its own shards and restore places
+  arrays directly onto the mesh sharding, so no device array gathers to one
+  host.  Host-side metadata (rehearsal memory, accuracy history,
+  bookkeeping) still funnels through a process-0 sidecar pickle — and the
+  rehearsal memory_store in it is the largest host-side state (up to
+  ``memory_size`` raw images), so the no-gather property applies to device
+  state only.  A checkpoint counts as complete only when both the sidecar
+  and orbax's atomically-finalized directory exist.
 """
 
 from __future__ import annotations

@@ -52,11 +52,19 @@ def device_step_ms_from_xspaces(xspaces, n_steps: int) -> dict:
                         durs_ps.append(ev.duration_ps)
     if not durs_ps:
         return {}
+    short = len(durs_ps) < n_steps
     durs_ps = sorted(durs_ps, reverse=True)[:n_steps]
-    return {
+    out = {
         "trace_step_ms": round(float(np.sum(durs_ps)) / 1e9 / len(durs_ps), 3),
         "trace_events_used": len(durs_ps),
     }
+    if short:
+        # Fewer jit_* device events than requested steps: the top-N now
+        # includes *every* jitted program in the trace (fence/metrics
+        # mini-programs included), which drags the mean down and inflates
+        # est_mfu_trace.  Flag it so the witness is never silently diluted.
+        out["trace_underpopulated"] = True
+    return out
 
 
 def trace_device_step_ms(trace_dir: str, n_steps: int) -> dict:

@@ -17,6 +17,7 @@ from pathlib import Path
 
 def load(path: str):
     tasks, final, meta = [], None, {}
+    epochs: dict = {}
     with open(path) as f:
         for line in f:
             try:
@@ -27,6 +28,10 @@ def load(path: str):
                 continue
             if rec.get("type") == "task":
                 tasks.append(rec)
+            elif rec.get("type") == "epoch" and "epoch_s" in rec:
+                epochs.setdefault(rec.get("task_id", 0), []).append(
+                    rec["epoch_s"]
+                )
             elif rec.get("type") == "final":
                 final = rec
             elif rec.get("type") == "run":
@@ -39,8 +44,20 @@ def load(path: str):
                 start = rec.get("start_task")
                 if start is not None:
                     tasks = [t for t in tasks if t.get("task_id", 0) < start]
+                    epochs = {t: v for t, v in epochs.items() if t < start}
                     final = None
-    return tasks, final, meta
+    return tasks, final, meta, epochs
+
+
+def compile_overhead_s(epoch_times):
+    """First-epoch wall time minus steady-state median: the visible XLA
+    (re)compile cost a fresh task pays (r3 Weak #7).  None when a task has
+    fewer than 2 timed epochs."""
+    if not epoch_times or len(epoch_times) < 2:
+        return None
+    rest = sorted(epoch_times[1:])
+    median = rest[len(rest) // 2]
+    return max(0.0, epoch_times[0] - median)
 
 
 def main(paths):
@@ -69,19 +86,25 @@ def main(paths):
         "this artifact.\n"
     )
     for path in paths:
-        tasks, final, meta = load(path)
+        tasks, final, meta, epochs = load(path)
         name = Path(path).stem
         print(f"## {name}\n")
         if meta:
             cfg = {k: v for k, v in meta.items() if k not in ("type", "ts")}
             print(f"config: `{json.dumps(cfg, sort_keys=True)}`\n")
-        print("| task | new classes | cum. top-1 (%) | WA γ | seconds |")
-        print("|---|---|---|---|---|")
+        print(
+            "| task | new classes | cum. top-1 (%) | WA γ | seconds "
+            "| compile s |"
+        )
+        print("|---|---|---|---|---|---|")
         for t in tasks:
             gamma = f"{t['gamma']:.4f}" if t.get("gamma") is not None else "—"
+            comp = compile_overhead_s(epochs.get(t.get("task_id", 0)))
+            comp_s = f"{comp:.1f}" if comp is not None else "—"
             print(
                 f"| {t['task_id']} | {t.get('nb_new', '?')} | "
-                f"{t['acc1']:.2f} | {gamma} | {t.get('seconds', '?')} |"
+                f"{t['acc1']:.2f} | {gamma} | {t.get('seconds', '?')} | "
+                f"{comp_s} |"
             )
         if final:
             print(

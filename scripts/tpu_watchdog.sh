@@ -5,12 +5,41 @@
 # a time (a killed client can wedge the chip); every probe runs in a killable
 # subprocess with a timeout so the watchdog itself never hangs.
 #
+# Evidence-preservation: bench/profile output is written to a temp file and
+# only moved into experiments/ on rc=0, so a timed-out or crashed capture
+# never overwrites previously captured evidence with an empty/partial file.
+# Every probe attempt is appended to experiments/tpu_watchdog.log (committed
+# even if the chip never answers, as proof of the attempt).
+#
 #   nohup setsid ./scripts/tpu_watchdog.sh &   # survives the session
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p experiments
+LOG=experiments/tpu_watchdog.log
+
+log() { echo "$(date -u +%FT%TZ) $*" | tee -a "$LOG"; }
+
+capture() {  # capture <timeout_s> <dest> <cmd...> — atomic move on success only
+  local t=$1 dest=$2; shift 2
+  local tmp
+  # Temp file lives in experiments/ itself: /tmp is often a separate tmpfs,
+  # where mv degrades to copy+unlink and a mid-copy kill could truncate
+  # previously captured evidence — same-filesystem rename is atomic.
+  tmp=$(mktemp experiments/.tpu_capture.XXXXXX)
+  if timeout "$t" "$@" > "$tmp" 2> "${dest}.err"; then
+    mv "$tmp" "$dest"
+    log "captured $dest: $(tail -1 "$dest")"
+    return 0
+  else
+    local rc=$?
+    log "capture of $dest failed rc=$rc (prior evidence preserved)"
+    rm -f "$tmp"
+    return "$rc"
+  fi
+}
 
 INTERVAL=${INTERVAL:-600}
+log "watchdog started (pid $$, interval ${INTERVAL}s)"
 while true; do
   if timeout -k 10 90 python -c "
 import jax, numpy as np
@@ -19,21 +48,17 @@ assert jax.default_backend() == 'tpu', jax.default_backend()
 float(np.asarray((x @ x).sum()))
 print('tpu alive')
 " >/dev/null 2>&1; then
-    echo "$(date -u +%FT%TZ) TPU alive — capturing bench + profiler witness"
-    timeout 1800 python bench.py > experiments/bench_tpu.json 2> /tmp/bench_tpu.err
-    timeout 900 python scripts/profile_mfu.py \
-      > experiments/profile_mfu_tpu.json 2> /tmp/profile_mfu_tpu.err
-    echo "$(date -u +%FT%TZ) captured:"
-    tail -1 experiments/bench_tpu.json || true
-    tail -1 experiments/profile_mfu_tpu.json || true
+    log "TPU alive — capturing bench + profiler witness"
+    capture 1800 experiments/bench_tpu.json python bench.py
+    capture 900 experiments/profile_mfu_tpu.json python scripts/profile_mfu.py
     # Full-recipe protocol evidence on the real chip: 140 epochs (the
     # reference's code default) is minutes on TPU vs hours on CPU.
-    echo "$(date -u +%FT%TZ) starting 140-epoch TPU protocol runs"
+    log "starting 140-epoch TPU protocol runs"
     EPOCHS=140 SUFFIX=_tpu140 timeout 10800 bash scripts/run_protocol.sh \
-      > /tmp/protocol_tpu.log 2>&1 || echo "TPU protocol rc=$?"
-    echo "$(date -u +%FT%TZ) watchdog done"
+      > /tmp/protocol_tpu.log 2>&1 || log "TPU protocol rc=$?"
+    log "watchdog done"
     exit 0
   fi
-  echo "$(date -u +%FT%TZ) TPU unreachable; retry in ${INTERVAL}s"
+  log "TPU unreachable; retry in ${INTERVAL}s"
   sleep "$INTERVAL"
 done

@@ -134,6 +134,54 @@ def test_rotate_identity_and_quarter():
     )
 
 
+def test_rotate_bicubic_pil_parity():
+    """The a=-1 cubic matches PIL's Geometry.c BICUBIC (the kernel timm's
+    geometric AugmentOps resolve to) to rounding error on interior pixels;
+    edge pixels differ because PIL fills whole out-of-source pixels while we
+    mix FILL per tap (VERDICT r3 Next #8: the ra_interpolation parity mode)."""
+    from PIL import Image
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+        _affine,
+        _rotate_matrix,
+    )
+
+    img = _img(11, size=32)
+    interior = np.s_[8:-8, 8:-8]
+    for deg in (17.0, -23.0):
+        mat = _rotate_matrix(img.shape, jnp.float32(deg))
+        ref = np.asarray(
+            _pil(img).rotate(deg, resample=Image.BICUBIC, fillcolor=(128,) * 3),
+            np.float32,
+        )
+        out = np.asarray(_round_u8(_affine(jnp.asarray(img), mat, "bicubic")))
+        assert np.abs(out[interior] - ref[interior]).max() <= 1.0
+        # And bilinear (the default) likewise matches PIL BILINEAR.
+        ref_bl = np.asarray(
+            _pil(img).rotate(deg, resample=Image.BILINEAR, fillcolor=(128,) * 3),
+            np.float32,
+        )
+        out_bl = np.asarray(_round_u8(_affine(jnp.asarray(img), mat)))
+        assert np.abs(out_bl[interior] - ref_bl[interior]).max() <= 1.0
+        # The two kernels genuinely differ (the knob is not a no-op).
+        assert np.abs(out[interior] - out_bl[interior]).max() > 1.0
+
+
+def test_ra_interpolation_modes_run_and_differ():
+    """ra_interpolation is threaded through the jitted pipeline; 'random'
+    (timm parity) draws per-op kernels, so with a fixed key the three modes
+    produce valid outputs and bicubic != bilinear."""
+    batch = np.random.RandomState(5).randint(0, 256, (8, 32, 32, 3), np.uint8)
+    key = jax.random.PRNGKey(0)
+    outs = {}
+    for mode in ("bilinear", "bicubic", "random"):
+        cfg = AugmentConfig(ra_interpolation=mode)
+        out = np.asarray(train_augment(key, jnp.asarray(batch), cfg))
+        assert out.shape == batch.shape and np.isfinite(out).all()
+        outs[mode] = out
+    assert not np.array_equal(outs["bilinear"], outs["bicubic"])
+
+
 def test_translate_moves_content():
     img = jnp.asarray(_img(6))
     # output->input map with +3: out[x] = in[x+3], content shifts left.

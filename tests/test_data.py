@@ -122,6 +122,66 @@ def test_herding_prefix_property():
     np.testing.assert_array_equal(small, large[:5])
 
 
+def test_cluster_herding_golden():
+    """Three well-separated blobs, nb=3: k-means selection must return exactly
+    one member of each blob, and that member is the one nearest its blob mean
+    (VERDICT r3 Next #7 — the previously untested herding method)."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.memory import (
+        herd_cluster,
+    )
+
+    rng = np.random.RandomState(42)
+    centers = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]])
+    sizes = (12, 7, 4)  # unequal: rank order must follow population
+    blobs = [c + 0.3 * rng.randn(s, 2) for c, s in zip(centers, sizes)]
+    feats = np.concatenate(blobs).astype(np.float32)
+    chosen = herd_cluster(feats, 3)
+    assert len(set(chosen.tolist())) == 3
+    blob_of = np.repeat(np.arange(3), sizes)
+    # One representative per blob...
+    assert sorted(blob_of[chosen].tolist()) == [0, 1, 2]
+    # ...in descending-population rank order, so quota-shrink truncation
+    # (RehearsalMemory.add) keeps the densest clusters' representatives.
+    assert blob_of[chosen].tolist() == [0, 1, 2]
+    # ...and each is its blob's nearest-to-mean member (k-means converges to
+    # the blob means on this separation).
+    for i in chosen:
+        b = blob_of[i]
+        members = np.where(blob_of == b)[0]
+        d = np.linalg.norm(feats[members] - feats[members].mean(0), axis=1)
+        assert i == members[d.argmin()]
+    # Unlike barycenter there is no cross-budget prefix guarantee (k-means
+    # re-runs per budget), but within one call the prefix is the rank.
+
+
+def test_cluster_herding_determinism_and_bounds():
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.memory import (
+        herd_cluster,
+    )
+
+    rng = np.random.RandomState(1)
+    feats = rng.randn(40, 6).astype(np.float32)
+    a = herd_cluster(feats, 10)
+    b = herd_cluster(feats.copy(), 10)
+    np.testing.assert_array_equal(a, b)  # fixed init seed -> deterministic
+    assert len(set(a.tolist())) == 10  # no duplicate exemplars
+    # nb > n degrades gracefully to a permutation of everything.
+    all_of_them = herd_cluster(feats[:4], 10)
+    assert sorted(all_of_them.tolist()) == [0, 1, 2, 3]
+
+
+def test_cluster_herding_via_memory():
+    # The "cluster" string dispatch works end-to-end through RehearsalMemory.
+    rng = np.random.RandomState(2)
+    y = np.repeat(np.arange(2, dtype=np.int64), 20)
+    x = rng.randint(0, 255, (40, 2, 2, 1), np.uint8)
+    feats = rng.randn(40, 4).astype(np.float32)
+    mem = RehearsalMemory(memory_size=10, herding_method="cluster")
+    mem.add(x, y, None, feats)
+    mx, my, _ = mem.get()
+    assert len(my) == 10 and sorted(np.unique(my).tolist()) == [0, 1]
+
+
 # --------------------------------------------------------------------------- #
 # RehearsalMemory quotas (SURVEY.md #20)
 # --------------------------------------------------------------------------- #
@@ -221,6 +281,18 @@ def test_train_batches_process_sharding():
     for b in range(len(full)):
         recon = np.concatenate([shards[i][b][1] for i in range(4)])
         np.testing.assert_array_equal(recon, full[b][1])
+
+
+def test_indivisible_batch_raises_loudly():
+    """The sharding guards are ValueErrors, not asserts: they must survive
+    ``python -O``, where a silent mis-shard would corrupt every batch
+    (VERDICT r3 Next #6)."""
+    x, y = _toy_dataset(nb_classes=4, per_class=16)
+    task = ClassIncremental(x, y, 0, 4)[0]
+    with pytest.raises(ValueError, match="not divisible"):
+        next(train_batches(task, 16, seed=0, process_index=0, process_count=3))
+    with pytest.raises(ValueError, match="not divisible"):
+        next(eval_batches(task, 16, process_index=0, process_count=3))
 
 
 def test_eval_batches_exact_weights():
@@ -325,6 +397,102 @@ def test_lazy_image_folder(tmp_path):
     s = ClassIncremental(paths, labels, initial_increment=0, increment=1)
     t0 = s[0]
     assert t0.x.dtype == object and len(t0) == 3
+
+
+def _cifar_blob(n, seed, label_base=0):
+    """A tiny valid cifar-100-python split: pickled dict with bytes keys,
+    [N, 3072] uint8 rows in CHW plane order, list fine_labels."""
+    import pickle
+
+    rng = np.random.RandomState(seed)
+    data = rng.randint(0, 256, (n, 3 * 32 * 32), np.uint8)
+    labels = [(label_base + i) % 100 for i in range(n)]
+    return (
+        pickle.dumps({b"data": data, b"fine_labels": labels, b"filenames": []}),
+        data,
+        labels,
+    )
+
+
+def test_cifar100_loader_fixture(tmp_path):
+    """Synthesized cifar-100-python fixture through every accepted layout:
+    extracted dir, parent dir, and the .tar.gz archive — asserting shapes,
+    dtype, the NCHW->NHWC transpose, and label passthrough (VERDICT r3
+    Next #2: the north-star code path, counterpart reference
+    utils.py:191-196)."""
+    import tarfile
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.datasets import (
+        load_cifar100,
+    )
+
+    train_blob, train_data, train_labels = _cifar_blob(6, seed=0)
+    test_blob, test_data, test_labels = _cifar_blob(4, seed=1, label_base=50)
+
+    root = tmp_path / "extracted"
+    (root / "cifar-100-python").mkdir(parents=True)
+    (root / "cifar-100-python" / "train").write_bytes(train_blob)
+    (root / "cifar-100-python" / "test").write_bytes(test_blob)
+
+    tar_path = tmp_path / "cifar-100-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(root / "cifar-100-python", arcname="cifar-100-python")
+
+    sources = [
+        str(root),                          # parent of cifar-100-python/
+        str(root / "cifar-100-python"),     # the extracted dir itself
+        str(tar_path),                      # the archive file
+        str(tmp_path),                      # dir containing the archive
+    ]
+    # (tmp_path also holds extracted/, but the candidate order prefers the
+    # archive name probe only after direct split files miss — tmp_path has
+    # neither split file, so it exercises the <dir>/cifar-100-python.tar.gz
+    # fallback.)
+    for src in sources:
+        x, y = load_cifar100(src, train=True)
+        assert x.shape == (6, 32, 32, 3) and x.dtype == np.uint8
+        assert x.flags["C_CONTIGUOUS"]
+        assert y.dtype == np.int64 and y.tolist() == train_labels
+        # NHWC pixel (n, h, w, c) == flat row element c*1024 + h*32 + w.
+        np.testing.assert_array_equal(
+            x, train_data.reshape(6, 3, 32, 32).transpose(0, 2, 3, 1)
+        )
+        xt, yt = load_cifar100(src, train=False)
+        assert xt.shape == (4, 32, 32, 3) and yt.tolist() == test_labels
+
+    with pytest.raises(FileNotFoundError):
+        load_cifar100(str(tmp_path / "missing"), train=True)
+
+
+def test_cifar100_through_scenario(tmp_path):
+    """build_raw_dataset('cifar') -> ClassIncremental: remapped labels and
+    task membership follow the class order, end to end from pickle bytes."""
+    import pickle
+
+    # 4 classes x 3 samples, constant per-class pixel value = original label.
+    data = np.concatenate(
+        [np.full((3, 3072), c * 10, np.uint8) for c in range(4)]
+    )
+    labels = np.repeat(np.arange(4), 3).tolist()
+    d = tmp_path / "cifar-100-python"
+    d.mkdir()
+    blob = pickle.dumps({b"data": data, b"fine_labels": labels})
+    (d / "train").write_bytes(blob)
+    (d / "test").write_bytes(blob)
+
+    (x, y), nb = build_raw_dataset("cifar", str(tmp_path), train=True)
+    assert nb == 4
+    scenario = ClassIncremental(
+        x, y, initial_increment=2, increment=1, class_order=[2, 0, 3, 1]
+    )
+    assert scenario.increments() == [2, 1, 1]
+    task0 = scenario[0]
+    # Task 0 = first two classes of the order (originals 2 and 0), labels
+    # remapped to 0/1; pixels identify the original class.
+    assert sorted(np.unique(task0.y).tolist()) == [0, 1]
+    orig = task0.x[:, 0, 0, 0] // 10
+    remap = {2: 0, 0: 1}
+    np.testing.assert_array_equal(task0.y, [remap[int(c)] for c in orig])
 
 
 def test_mnist_idx_loader(tmp_path):

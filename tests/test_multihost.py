@@ -55,7 +55,8 @@ print("RESULT" + json.dumps({
 """
 
 
-def test_two_process_cluster_trains_in_lockstep(tmp_path):
+def _run_cluster(tmp_path, worker_src, extra_env=None, name="worker"):
+    """Launch a 2-process jax.distributed cluster; return per-pid RESULT dicts."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -70,9 +71,10 @@ def test_two_process_cluster_trains_in_lockstep(tmp_path):
             "CIL_TPU_NO_NATIVE": "",  # native allowed; agreement path runs
         }
     )
+    env.update(extra_env or {})
     env.pop("JAX_COORDINATOR_ADDRESS", None)
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+    script = tmp_path / f"{name}.py"
+    script.write_text(worker_src)
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i)],
@@ -85,7 +87,9 @@ def test_two_process_cluster_trains_in_lockstep(tmp_path):
     ]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=850)
+        # Generous budget: on a contended CPU the 2-process compile +
+        # orbax writes have been observed to take >850 s with zero hangs.
+        out, _ = p.communicate(timeout=1600)
         outs.append(out)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
@@ -96,9 +100,94 @@ def test_two_process_cluster_trains_in_lockstep(tmp_path):
         r = json.loads(line[len("RESULT"):])
         results[r["pid"]] = r
     assert set(results) == {0, 1}
+    return results
+
+
+def test_two_process_cluster_trains_in_lockstep(tmp_path):
+    results = _run_cluster(tmp_path, _WORKER)
     # Replicated training state: identical accuracy histories and identical
     # herded memories on every process, with zero memory-sync communication.
     assert results[0]["acc1s"] == results[1]["acc1s"]
     assert results[0]["memory_labels"] == results[1]["memory_labels"]
     assert results[0]["memory_checksum"] == results[1]["memory_checksum"]
     assert len(results[0]["acc1s"]) == 2
+
+
+_CKPT_WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["CIL_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["CIL_COORD"],
+    num_processes=2,
+    process_id=int(sys.argv[1]),
+)
+import numpy as np
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import CilTrainer
+
+resume = os.environ["CIL_RESUME"] == "1"
+cfg = CilConfig(
+    data_set="synthetic10", num_bases=0, increment=5, backbone="resnet20",
+    batch_size=4, num_epochs=1, eval_every_epoch=100, memory_size=40,
+    lr=0.05, aa=None, color_jitter=0.0, seed=7,
+    ckpt_dir=os.environ["CIL_CKPT"], ckpt_backend="orbax", resume=resume,
+)
+trainer = CilTrainer(cfg)  # default mesh: all 8 global devices
+if resume:
+    assert trainer.start_task == 1, trainer.start_task
+    assert trainer.known == 5 and trainer.teacher is not None
+result = trainer.fit()
+mx, my, mt = trainer.memory.get()
+params_md5 = hashlib.md5(
+    b"".join(
+        np.ascontiguousarray(np.asarray(l)).tobytes()
+        for l in jax.tree_util.tree_leaves(trainer.state.params)
+    )
+).hexdigest()
+print("RESULT" + json.dumps({
+    "pid": int(sys.argv[1]),
+    "acc1s": result["acc1s"],
+    "memory_labels": np.asarray(my).tolist(),
+    "memory_checksum": int(np.asarray(mx, np.int64).sum()),
+    "params_md5": params_md5,
+}), flush=True, force=True)
+"""
+
+
+def test_multihost_orbax_checkpoint_kill_and_resume(tmp_path):
+    """VERDICT r3 Next #4: the orbax multi-host machinery — barrier
+    sequencing, per-process shard writes, resume-point agreement check
+    (utils/checkpoint.py) — exercised in the 2-process topology it exists
+    for.  The uninterrupted cluster run writes per-task checkpoints; both
+    processes then 'die' (exit), the task-1 checkpoint is dropped to land
+    the resume point after task 0, and a fresh cluster resumes — it must
+    reproduce the uninterrupted run bit-for-bit."""
+    import shutil
+
+    ckpt = str(tmp_path / "ckpts")
+    full = _run_cluster(
+        tmp_path,
+        _CKPT_WORKER,
+        extra_env={"CIL_CKPT": ckpt, "CIL_RESUME": "0"},
+        name="full",
+    )
+    assert full[0]["params_md5"] == full[1]["params_md5"]
+    assert os.path.isdir(os.path.join(ckpt, "task_001.orbax"))
+
+    # Crash after task 0: the task-1 checkpoint never finished.
+    shutil.rmtree(os.path.join(ckpt, "task_001.orbax"))
+    os.remove(os.path.join(ckpt, "task_001.orbax.meta"))
+
+    resumed = _run_cluster(
+        tmp_path,
+        _CKPT_WORKER,
+        extra_env={"CIL_CKPT": ckpt, "CIL_RESUME": "1"},
+        name="resumed",
+    )
+    for pid in (0, 1):
+        assert resumed[pid]["acc1s"] == full[pid]["acc1s"]
+        assert resumed[pid]["memory_labels"] == full[pid]["memory_labels"]
+        assert resumed[pid]["memory_checksum"] == full[pid]["memory_checksum"]
+        assert resumed[pid]["params_md5"] == full[pid]["params_md5"]
