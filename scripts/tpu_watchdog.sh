@@ -25,8 +25,10 @@ capture() {  # capture <timeout_s> <dest> <cmd...> — atomic move on success on
   # Temp file lives in experiments/ itself: /tmp is often a separate tmpfs,
   # where mv degrades to copy+unlink and a mid-copy kill could truncate
   # previously captured evidence — same-filesystem rename is atomic.
+  # stderr goes to /tmp (diagnostic noise, not evidence; keeps the
+  # committed experiments/ dir free of machine-local .err files).
   tmp=$(mktemp experiments/.tpu_capture.XXXXXX)
-  if timeout "$t" "$@" > "$tmp" 2> "${dest}.err"; then
+  if timeout "$t" "$@" > "$tmp" 2> "/tmp/$(basename "$dest").err"; then
     mv "$tmp" "$dest"
     log "captured $dest: $(tail -1 "$dest")"
     return 0
@@ -53,8 +55,12 @@ print('tpu alive')
     capture 900 experiments/profile_mfu_tpu.json python scripts/profile_mfu.py
     # Full-recipe protocol evidence on the real chip: 140 epochs (the
     # reference's code default) is minutes on TPU vs hours on CPU.
+    # MEMORY=256 + synthetic_hard128 = the dynamics-valid regime (the
+    # default 2000-exemplar budget nearly replays the 6400-image synthetic
+    # stream, so no forgetting could show — see run_protocol.sh).
     log "starting 140-epoch TPU protocol runs"
-    EPOCHS=140 SUFFIX=_tpu140 timeout 10800 bash scripts/run_protocol.sh \
+    EPOCHS=140 SUFFIX=_tpu140 DATASET=synthetic_hard128 MEMORY=256 \
+      AA=rand-m9-mstd0.5-inc1 timeout 10800 bash scripts/run_protocol.sh \
       > /tmp/protocol_tpu.log 2>&1 || log "TPU protocol rc=$?"
     log "watchdog done"
     exit 0

@@ -111,6 +111,74 @@ def test_sharded_step_equals_single_device(devices8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3)
 
 
+def test_fused_epoch_equals_per_batch_step(devices8):
+    """The fused lax.scan epoch program and the per-batch step are the same
+    math: over a dataset of exactly one global batch with key-dependent
+    augmentation off (normalize only), one fused epoch must equal one
+    per-batch step up to batch-order float summation.  The two paths draw
+    their shuffles from different sources (on-device permutation vs host
+    RandomState), which for a single wrap-padded batch only permutes rows
+    inside the batch — irrelevant to BN/CE reductions and SGD."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+        AugmentConfig,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine.train import (
+        make_epoch_fn,
+        make_train_step,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import (
+        replicated,
+    )
+
+    cfg = _smoke_config(batch_size=16, increment=10)  # global batch 128
+    trainer = CilTrainer(cfg, mesh=make_mesh((8, 1)), init_dist=False)
+    trainer.state = trainer._grow_state(trainer.state, 0, 0, 10)
+    aug = AugmentConfig(
+        crop_padding=0, hflip=False, rand_augment=False, color_jitter=0.0
+    )
+    mk = dict(
+        label_smoothing=0.0,
+        kd_temperature=2.0,
+        momentum=0.9,
+        weight_decay=5e-4,
+        has_teacher=False,
+        mesh=trainer.mesh,
+    )
+    step = make_train_step(trainer.model, aug, **mk)
+    epoch_fn = make_epoch_fn(trainer.model, aug, **mk)
+
+    rng = np.random.RandomState(0)
+    n = trainer.global_batch_size  # dataset == exactly one global batch
+    x = rng.randint(0, 256, (n, 32, 32, 3), np.uint8)
+    y = rng.randint(0, 10, n).astype(np.int64)
+    key = jax.random.PRNGKey(5)
+
+    # Fused path: dataset replicated in device memory, one-scan epoch.
+    data_x, data_y = trainer._put(x, y, sharding=replicated(trainer.mesh))
+    state_f = jax.tree_util.tree_map(jnp.copy, trainer.state)
+    state_f, metrics_f = epoch_fn(
+        state_f, None, data_x, data_y, key, 0.1, 0.5, trainer.global_batch_size
+    )
+    # Per-batch path: the host loader yields the same single batch (in its
+    # own shuffle order); step key fold matches the scan body's fold_in.
+    xd, yd = trainer._put(x, y)
+    state_b, metrics_b = step(
+        trainer.state, None, xd, yd, jax.random.fold_in(key, 0), 0.1, 0.5
+    )
+
+    assert metrics_f["loss"].shape == (1,)  # one scan step
+    assert np.isclose(
+        float(metrics_f["loss"][0]), float(metrics_b["loss"]), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_f.params),
+        jax.tree_util.tree_leaves(state_b.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3
+        )
+
+
 def test_same_seed_reproducible(devices8):
     """Same seed -> identical first-epoch loss trajectory (PRNG threading)."""
     cfg = _smoke_config(num_epochs=1, increment=10)
