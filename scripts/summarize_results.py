@@ -64,11 +64,18 @@ def main(paths):
     print("# RESULTS — committed protocol-scale runs\n")
     print(
         "Synthetic-100 (class-separable low-frequency templates + heavy "
-        "pixel noise, `data/datasets.load_synthetic` via `synthetic_hard`) "
-        "at reduced epochs: evidence that the full WA protocol — head "
-        "growth, KD, weight alignment, herding, shrinking rehearsal "
-        "quotas — works over every task, independent of any dataset on "
-        "disk. Reproduce with `scripts/run_protocol.sh`.\n"
+        "pixel noise, `data/datasets.load_synthetic` via `synthetic_hard*`) "
+        "runs in two regimes, both reproducible with "
+        "`scripts/run_protocol.sh`:\n\n"
+        "- **Mechanism-proof** (`*_synthetic_hard`, memory 2000): every WA "
+        "stage — head growth, KD, weight alignment, herding, shrinking "
+        "quotas — executes over every task. With 2000 exemplars against a "
+        "6400-image stream, rehearsal nearly replays the data, so "
+        "accuracies saturate and no forgetting can show (by design).\n"
+        "- **Dynamics-proof** (`*_mem256`, memory 256 = the reference's "
+        "2000/50000 ≈ 4% rehearsal pressure, RandAugment on, σ=128 noise): "
+        "the trajectory shows real forgetting and the WA γ correction "
+        "(γ<1 pulls the over-normed new head down each task).\n"
     )
     print(
         "Context for reading the tables: (1) No real CIFAR-100/ImageNet "
@@ -82,8 +89,8 @@ def main(paths):
         "640-image first task of B0-Inc10 is undertrained (tens of SGD "
         "steps); cumulative accuracy recovers over later tasks as "
         "rehearsal replays those classes — visible below as a rising-then-"
-        "declining trajectory. The full 140-epoch recipe does not have "
-        "this artifact.\n"
+        "declining trajectory. More epochs shrink (not fully remove) the "
+        "artifact: synthetic-100 has 64 images/class vs CIFAR's 500.\n"
     )
     for path in paths:
         tasks, final, meta, epochs = load(path)
