@@ -75,7 +75,14 @@ def main(paths):
         "- **Dynamics-proof** (`*_mem256`, memory 256 = the reference's "
         "2000/50000 ≈ 4% rehearsal pressure, RandAugment on, σ=128 noise): "
         "the trajectory shows real forgetting and the WA γ correction "
-        "(γ<1 pulls the over-normed new head down each task).\n"
+        "(γ<1 pulls the over-normed new head down each task).\n\n"
+        "Runs suffixed `_resume` were SIGKILLed mid-task and relaunched "
+        "with `--resume` from their orbax checkpoints (the `resume` marker "
+        "in the JSONL records the restart point); task-boundary resume is "
+        "exact, so their accuracy and γ columns must match the "
+        "uninterrupted twin run bit-for-bit (the wall-clock/compile "
+        "columns legitimately differ) — live preemption-recovery "
+        "evidence, not a separate configuration.\n"
     )
     print(
         "Context for reading the tables: (1) No real CIFAR-100/ImageNet "
