@@ -98,8 +98,11 @@ class CilConfig:
     recount: int = 1
     resplit: bool = False          # parsed but dead in the reference too
     ra_interpolation: str = "bilinear"  # geometric RandAugment resampling:
-    # "bilinear" (branch-free device default) | "bicubic" | "random" = timm
-    # 0.5.4 parity (each applied op picks bilinear/bicubic at random)
+    # "bilinear" (branch-free device default) | "bicubic" = REFERENCE parity
+    # (utils.py:222 passes interpolation='bicubic' to create_transform, which
+    # timm 0.5.4 honors deterministically for the geometric ops) | "random" =
+    # timm's generic no-hint default (each applied op picks bilinear/bicubic
+    # at random; NOT what the reference recipe does)
 
     # Rehearsal memory
     herding_method: str = "barycenter"
@@ -199,8 +202,10 @@ def get_args_parser() -> argparse.ArgumentParser:
     p.add_argument("--resplit", action="store_true", default=False)
     p.add_argument("--ra_interpolation", default=d.ra_interpolation, type=str,
                    choices=("bilinear", "bicubic", "random"),
-                   help="geometric RandAugment resampling; 'random' = timm "
-                   "0.5.4 parity (per-op bilinear/bicubic choice)")
+                   help="geometric RandAugment resampling; 'bicubic' = "
+                   "reference parity (utils.py:222 passes an explicit "
+                   "bicubic hint); 'random' = timm's no-hint default "
+                   "(per-op bilinear/bicubic choice)")
     p.add_argument("--herding_method", default=d.herding_method, type=str)
     p.add_argument("--memory_size", default=d.memory_size, type=int)
     p.add_argument("--fixed_memory", action="store_true", default=False)

@@ -21,9 +21,12 @@ Pipeline fidelity (timm 0.5.4 semantics, ``rand-m9-mstd0.5-inc1`` default):
   N(9, 0.5) clipped to [0, 10], random sign for signed ops, fill 128 for
   geometric ops.  Geometric resampling follows ``ra_interpolation``:
   ``"bilinear"`` (default — one fixed kernel keeps the warp single-pass on
-  device), ``"bicubic"``, or ``"random"`` = timm 0.5.4 parity (each applied
-  geometric op independently picks bilinear or bicubic, timm's
-  ``_RANDOM_INTERPOLATION``; costs a second warp pass under vmap).
+  device); ``"bicubic"`` = reference parity (the reference passes
+  ``interpolation='bicubic'`` to ``create_transform``, ``utils.py:222``,
+  and timm 0.5.4 honors an explicit hint deterministically); ``"random"`` =
+  timm's generic no-hint default (each applied geometric op independently
+  picks bilinear or bicubic, timm's ``_RANDOM_INTERPOLATION``; costs a
+  second warp pass under vmap — and is NOT the reference recipe's behavior).
 * ``Normalize``: ``(x/255 - mean) / std`` with the stats chosen by
   ``CilConfig.normalization_stats()`` (preserving the reference's
   CIFAR-vs-ImageNet quirk, ``utils.py:231-233``).

@@ -272,10 +272,19 @@ def build_raw_dataset(
         # recovery) is visible in RESULTS.md, not saturated away.  A numeric
         # suffix sets the noise std directly (e.g. synthetic_hard128);
         # bare "synthetic_hard" keeps the round-3 level of 96.
-        std = float(name[len("synthetic_hard"):] or 96.0)
+        suffix = name[len("synthetic_hard"):]
+        # Decimal-digits-only: a typo like "synthetic_hardx" (or "nan"/"1e3")
+        # must fail as an unknown dataset, not parse as a noise level.
+        # isdecimal, not isdigit: isdigit accepts superscripts float() rejects.
+        if suffix and not suffix.isdecimal():
+            raise ValueError(f"Unknown dataset {data_set}.")
+        std = float(suffix) if suffix else 96.0
         x, y = load_synthetic(train=train, noise_std=std)
     elif name.startswith("synthetic"):  # e.g. synthetic20 for smoke runs
-        x, y = load_synthetic(nb_classes=int(name[len("synthetic"):]), train=train)
+        suffix = name[len("synthetic"):]
+        if not suffix.isdecimal():  # same typo guard as synthetic_hard above
+            raise ValueError(f"Unknown dataset {data_set}.")
+        x, y = load_synthetic(nb_classes=int(suffix), train=train)
     elif name == "imagenet1000":
         x, y = load_image_folder(data_path, train)
     else:

@@ -61,6 +61,17 @@ from .train import (
 )
 
 
+def _eval_line(totals) -> str:
+    """The eval report line (reference ``template.py:186`` format), shared by
+    the cumulative and slice-derived eval paths so they cannot drift."""
+    loss_sum, c1, c5, n = totals
+    return (
+        f" Acc@1 {100.0 * c1 / max(n, 1.0):.3f}"
+        f"  Acc@5 {100.0 * c5 / max(n, 1.0):.3f}"
+        f"  loss {loss_sum / max(n, 1.0):.3f}"
+    )
+
+
 class CilTrainer:
     """Builds the mesh/model/data and runs the class-incremental experiment."""
 
@@ -228,6 +239,7 @@ class CilTrainer:
             processes=jax.process_count(),
         )
         self.acc1s: List[float] = []
+        self.acc_matrix: List[List[float]] = []  # row t = acc_per_task after task t
         self.known = 0
         self.start_task = 0
         if config.resume and config.ckpt_dir:
@@ -280,8 +292,29 @@ class CilTrainer:
             if task_id > 0:
                 self.state, gamma = self._align_state(self.state, self.known, nb_new)
                 print(f"old norm / new norm ={gamma}")
-            acc1 = self.evaluate(dataset_val)
+            # Accuracy-matrix row: every seen task's val slice evaluated
+            # separately (scenario_val[j], the same slicing the reference's
+            # cumulative eval builds on, template.py:229).  The cumulative
+            # acc1 says *that* forgetting happened; the row says *where* —
+            # per class group — making backward transfer / forgetting
+            # computable from the JSONL.  The evaluator is exact weighted
+            # counting, so summing the slice totals reproduces the
+            # cumulative metrics without a second full pass; vs the old
+            # single cumulative pass this costs only the per-slice batch-
+            # boundary padding (up to task_id extra padded batches).
+            slice_totals = [
+                self._eval_totals(self.scenario_val[j])
+                for j in range(task_id + 1)
+            ]
+            totals = np.sum(slice_totals, axis=0)
+            print(_eval_line(totals))
+            acc1 = float(100.0 * totals[1] / max(totals[3], 1.0))
             self.acc1s.append(acc1)
+            acc_per_task = [
+                round(float(100.0 * t[1] / max(t[3], 1.0)), 5)
+                for t in slice_totals
+            ]
+            self.acc_matrix.append(acc_per_task)
             task_s = time.time() - t0
             print(
                 f"task id = {task_id}  @Acc1 = {acc1:.5f}, acc1s = {self.acc1s}"
@@ -292,6 +325,7 @@ class CilTrainer:
                 task_id=task_id,
                 acc1=acc1,
                 acc1s=list(self.acc1s),
+                acc_per_task=acc_per_task,
                 gamma=gamma,
                 nb_new=nb_new,
                 known_after=self.known + nb_new,
@@ -314,6 +348,7 @@ class CilTrainer:
         self.jsonl.log("final", acc1s=list(self.acc1s), avg_incremental_acc1=avg_inc)
         return {
             "acc1s": self.acc1s,
+            "acc_matrix": self.acc_matrix,
             "avg_incremental_acc1": avg_inc,
             "nb_tasks": len(increments),
         }
@@ -465,7 +500,10 @@ class CilTrainer:
     # Eval (reference template.py:169-188)
     # ------------------------------------------------------------------ #
 
-    def evaluate(self, dataset_val) -> float:
+    def _eval_totals(self, dataset_val) -> np.ndarray:
+        """Weighted-count totals ``[loss_sum, correct1, correct5, n]`` over a
+        val set; padding batches carry zero weight, so totals over disjoint
+        slices sum exactly to the totals over their union."""
         pidx, pcount = jax.process_index(), jax.process_count()
         totals = None
         for xb, yb, wb in eval_batches(
@@ -486,11 +524,12 @@ class CilTrainer:
             # (per-scalar fetches are ~90 ms RPCs on tunneled platforms).
             s = jnp.stack(out)
             totals = s if totals is None else totals + s
-        loss_sum, c1, c5, n = np.asarray(totals)
-        acc1 = 100.0 * c1 / max(n, 1.0)
-        acc5 = 100.0 * c5 / max(n, 1.0)
-        print(f" Acc@1 {acc1:.3f}  Acc@5 {acc5:.3f}  loss {loss_sum / max(n, 1.0):.3f}")
-        return float(acc1)
+        return np.asarray(totals)
+
+    def evaluate(self, dataset_val) -> float:
+        totals = self._eval_totals(dataset_val)
+        print(_eval_line(totals))
+        return float(100.0 * totals[1] / max(totals[3], 1.0))
 
     # ------------------------------------------------------------------ #
     # Herding pass (reference template.py:292-302)

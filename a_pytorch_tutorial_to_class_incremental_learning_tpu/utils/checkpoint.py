@@ -53,6 +53,8 @@ def _metadata(trainer, task_id: int) -> dict:
         "task_id": task_id,
         "known": trainer.known,  # already includes this task's classes
         "acc1s": list(trainer.acc1s),
+        "acc_matrix": [list(r) if r is not None else None
+                       for r in trainer.acc_matrix],
         "memory_store": trainer.memory._store,
         "config_seed": trainer.config.seed,
     }
@@ -198,6 +200,14 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
     )
     trainer.known = known
     trainer.acc1s = list(payload["acc1s"])
+    # .get: pre-matrix checkpoints (r4 and earlier) lack the key.  Pad to
+    # len(acc1s) with None rows so row index stays == task_id for the tasks
+    # appended after resume (consumers see None where the matrix predates
+    # the checkpoint, never a silently shifted row).
+    matrix = [list(r) if r is not None else None
+              for r in payload.get("acc_matrix", [])]
+    matrix += [None] * (len(payload["acc1s"]) - len(matrix))
+    trainer.acc_matrix = matrix
     trainer.memory._store = payload["memory_store"]
     trainer.start_task = payload["task_id"] + 1
     print(f"| resumed from {path}: next task {trainer.start_task}, known={known}")

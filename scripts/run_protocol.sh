@@ -27,16 +27,28 @@ MEMORY=${MEMORY:-2000}
 # so forgetting and WA recovery are visible in the trajectory.
 DATASET=${DATASET:-synthetic_hard}
 SUFFIX=${SUFFIX:-}  # e.g. SUFFIX=_tpu140 to keep runs side by side
+ONLY=${ONLY:-}      # b0 | b50 | empty = both (single-protocol runs: the
+                    # machine has ONE cpu core, so a full B0+B50 pair costs
+                    # ~4h wall; B50 alone is the flagship 6-task protocol)
+case "$ONLY" in
+  ""|b0|b50) ;;
+  *) echo "ONLY must be 'b0', 'b50' or empty, got '$ONLY'" >&2; exit 2 ;;
+esac
+EXTRA_ARGS=${EXTRA_ARGS:-}  # e.g. "--compute_dtype bfloat16"
 
+if [ "$ONLY" != "b50" ]; then
 python train.py --data_set "$DATASET" --num_bases 0 --increment 10 \
   --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
-  --memory_size "$MEMORY" --seed "$SEED" $PLATFORM_ARGS \
+  --memory_size "$MEMORY" --seed "$SEED" $PLATFORM_ARGS $EXTRA_ARGS \
   --log_file "experiments/b0_inc10_${DATASET}${SUFFIX}.jsonl"
+fi
 
+if [ "$ONLY" != "b0" ]; then
 python train.py --data_set "$DATASET" --num_bases 50 --increment 10 \
   --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
-  --memory_size "$MEMORY" --seed "$SEED" $PLATFORM_ARGS \
+  --memory_size "$MEMORY" --seed "$SEED" $PLATFORM_ARGS $EXTRA_ARGS \
   --log_file "experiments/b50_inc10_${DATASET}${SUFFIX}.jsonl"
+fi
 
 # Render every committed-evidence log present, not just this invocation's.
 python scripts/summarize_results.py experiments/*.jsonl > RESULTS.md

@@ -60,6 +60,49 @@ def compile_overhead_s(epoch_times):
     return max(0.0, epoch_times[0] - median)
 
 
+def render_matrix(tasks):
+    """Accuracy-matrix table: row t = after training task t, column j = top-1
+    on task j's own val slice (``acc_per_task`` in the task records).  Renders
+    per-task forgetting (best prior accuracy on j minus final accuracy on j)
+    and BWT (mean over j<T-1 of final minus just-after-training accuracy) —
+    the standard continual-learning decomposition the cumulative trajectory
+    can't show."""
+    rows = {
+        t.get("task_id", i): t.get("acc_per_task") for i, t in enumerate(tasks)
+    }
+    if not rows or any(r is None for r in rows.values()):
+        return  # older logs predate the matrix
+    # Rows are keyed by task_id, NOT list position: a --resume relaunch into
+    # a fresh log file starts mid-protocol, and positional indexing would
+    # silently publish wrong forgetting/BWT numbers for it.
+    T = max(len(r) for r in rows.values())
+    print("accuracy matrix (row = after task t, col = val slice of task j):\n")
+    print("| after task | " + " | ".join(f"j={j}" for j in range(T)) + " |")
+    print("|---|" + "---|" * T)
+    for tid in sorted(rows):
+        r = rows[tid]
+        cells = [f"{a:.2f}" for a in r] + ["—"] * (T - len(r))
+        print(f"| {tid} | " + " | ".join(cells) + " |")
+    complete = sorted(rows) == list(range(T)) and all(
+        len(rows[t]) == t + 1 for t in rows
+    )
+    if T > 1 and complete:
+        final_row = rows[T - 1]
+        forgetting = [
+            max(rows[t][j] for t in range(j, T - 1)) - final_row[j]
+            for j in range(T - 1)
+        ]
+        bwt = sum(final_row[j] - rows[j][j] for j in range(T - 1)) / (T - 1)
+        fstr = ", ".join(f"j={j}: {f:+.2f}" for j, f in enumerate(forgetting))
+        print(f"\nforgetting (best−final per slice): {fstr}")
+        print(f"\nBWT (mean final−diagonal): {bwt:+.3f}\n")
+    elif T > 1:
+        print(
+            "\n(partial matrix — log starts mid-protocol; forgetting/BWT "
+            "need rows for every task)\n"
+        )
+
+
 def main(paths):
     print("# RESULTS — committed protocol-scale runs\n")
     print(
@@ -120,6 +163,8 @@ def main(paths):
                 f"{t['acc1']:.2f} | {gamma} | {t.get('seconds', '?')} | "
                 f"{comp_s} |"
             )
+        print()
+        render_matrix(tasks)
         if final:
             print(
                 f"\n**avg incremental top-1: "

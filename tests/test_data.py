@@ -340,6 +340,18 @@ def test_unknown_dataset_raises():
         build_raw_dataset("nope", "", train=True)
 
 
+def test_synthetic_suffix_typos_rejected():
+    # Non-numeric suffixes must fail as unknown datasets, not parse as a
+    # noise level / class count ("nan"/"inf"/"1e3" would pass float()).
+    for bad in ("synthetic_hardx", "synthetic_hardnan", "synthetic_hard1e3",
+                "synthetic_hard-5", "syntheticx"):
+        with pytest.raises(ValueError, match="Unknown dataset"):
+            build_raw_dataset(bad, "", train=True)
+    # The documented numeric forms still work.
+    (x, _), _ = build_raw_dataset("synthetic_hard128", "", train=True)
+    assert x.dtype == np.uint8
+
+
 def test_parse_rand_augment():
     from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
         parse_rand_augment,

@@ -53,6 +53,18 @@ def test_two_task_run_above_chance(two_task_result):
     )
 
 
+def test_accuracy_matrix_consistent_with_cumulative(two_task_result):
+    trainer, result = two_task_result
+    # Lower-triangular matrix: row t has t+1 per-slice accuracies.
+    assert [len(r) for r in result["acc_matrix"]] == [1, 2]
+    # The val slices partition the cumulative set, so the cumulative top-1
+    # must equal the slice-size-weighted mean of the row.
+    for t, row in enumerate(result["acc_matrix"]):
+        sizes = [len(trainer.scenario_val[j]) for j in range(t + 1)]
+        weighted = sum(a * n for a, n in zip(row, sizes)) / sum(sizes)
+        assert result["acc1s"][t] == pytest.approx(weighted, abs=1e-3)
+
+
 def test_memory_and_head_state_after_run(two_task_result):
     trainer, _ = two_task_result
     # After 2 tasks of 5 classes: memory covers all 10, head fully active.

@@ -86,3 +86,78 @@ def test_render_table_includes_compile_column(tmp_path):
     assert "| compile s |" in out
     assert "| 0 | 10 | 77.50 | — | 99.0 | 25.0 |" in out
     assert "avg incremental top-1: 77.500%" in out
+
+
+def test_render_accuracy_matrix_with_forgetting_and_bwt(tmp_path):
+    m = _mod()
+    path = str(tmp_path / "b0_matrix.jsonl")
+    _write_jsonl(
+        path,
+        [
+            {"type": "run", "seed": 0},
+            {"type": "task", "task_id": 0, "acc1": 90.0, "nb_new": 5,
+             "acc_per_task": [90.0]},
+            {"type": "task", "task_id": 1, "acc1": 70.0, "nb_new": 5,
+             "acc_per_task": [60.0, 80.0]},
+            {"type": "task", "task_id": 2, "acc1": 60.0, "nb_new": 5,
+             "acc_per_task": [50.0, 65.0, 65.0]},
+            {"type": "final", "acc1s": [90.0, 70.0, 60.0],
+             "avg_incremental_acc1": 73.333},
+        ],
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main([path])
+    out = buf.getvalue()
+    # Lower-triangular render with em-dash padding.
+    assert "| 0 | 90.00 | — | — |" in out
+    assert "| 2 | 50.00 | 65.00 | 65.00 |" in out
+    # Forgetting: best prior minus final — j=0: 90-50=+40, j=1: 80-65=+15.
+    assert "j=0: +40.00" in out and "j=1: +15.00" in out
+    # BWT: mean(final-diagonal) over j<T-1 = ((50-90)+(65-80))/2 = -27.5.
+    assert "BWT (mean final−diagonal): -27.500" in out
+
+
+def test_render_partial_matrix_keyed_by_task_id(tmp_path):
+    m = _mod()
+    # A --resume relaunch into a FRESH log file: records start at task 2.
+    path = str(tmp_path / "b0_partial.jsonl")
+    _write_jsonl(
+        path,
+        [
+            {"type": "run", "seed": 0},
+            {"type": "task", "task_id": 2, "acc1": 60.0, "nb_new": 5,
+             "acc_per_task": [50.0, 65.0, 65.0]},
+            {"type": "task", "task_id": 3, "acc1": 55.0, "nb_new": 5,
+             "acc_per_task": [45.0, 60.0, 55.0, 60.0]},
+            {"type": "final", "acc1s": [90.0, 70.0, 60.0, 55.0],
+             "avg_incremental_acc1": 68.75},
+        ],
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main([path])
+    out = buf.getvalue()
+    # Rows carry their true task ids, not list positions.
+    assert "| 2 | 50.00 | 65.00 | 65.00 | — |" in out
+    assert "| 3 | 45.00 | 60.00 | 55.00 | 60.00 |" in out
+    # Forgetting/BWT would be wrong without rows 0-1 — must be withheld.
+    assert "BWT (mean final−diagonal)" not in out
+    assert "partial matrix" in out
+
+
+def test_render_skips_matrix_for_pre_matrix_logs(tmp_path):
+    m = _mod()
+    path = str(tmp_path / "b0_old.jsonl")
+    _write_jsonl(
+        path,
+        [
+            {"type": "run", "seed": 0},
+            {"type": "task", "task_id": 0, "acc1": 90.0, "nb_new": 5},
+            {"type": "final", "acc1s": [90.0], "avg_incremental_acc1": 90.0},
+        ],
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main([path])
+    assert "accuracy matrix" not in buf.getvalue()
