@@ -302,11 +302,15 @@ class CilTrainer:
             # cumulative metrics without a second full pass; vs the old
             # single cumulative pass this costs only the per-slice batch-
             # boundary padding (up to task_id extra padded batches).
-            slice_totals = [
-                self._eval_totals(self.scenario_val[j])
+            # Slice totals stay ON DEVICE until all slices are evaluated —
+            # one host fetch for the whole matrix row, not one per seen
+            # task (~90 ms RPC each on tunneled platforms).
+            slice_dev = [
+                self._eval_totals_device(self.scenario_val[j])
                 for j in range(task_id + 1)
             ]
-            totals = np.sum(slice_totals, axis=0)
+            slice_totals = np.asarray(jnp.stack(slice_dev))
+            totals = slice_totals.sum(axis=0)
             print(_eval_line(totals))
             acc1 = float(100.0 * totals[1] / max(totals[3], 1.0))
             self.acc1s.append(acc1)
@@ -500,10 +504,11 @@ class CilTrainer:
     # Eval (reference template.py:169-188)
     # ------------------------------------------------------------------ #
 
-    def _eval_totals(self, dataset_val) -> np.ndarray:
+    def _eval_totals_device(self, dataset_val) -> jax.Array:
         """Weighted-count totals ``[loss_sum, correct1, correct5, n]`` over a
-        val set; padding batches carry zero weight, so totals over disjoint
-        slices sum exactly to the totals over their union."""
+        val set, left on device (callers batch the host fetch); padding
+        batches carry zero weight, so totals over disjoint slices sum
+        exactly to the totals over their union."""
         pidx, pcount = jax.process_index(), jax.process_count()
         totals = None
         for xb, yb, wb in eval_batches(
@@ -524,10 +529,10 @@ class CilTrainer:
             # (per-scalar fetches are ~90 ms RPCs on tunneled platforms).
             s = jnp.stack(out)
             totals = s if totals is None else totals + s
-        return np.asarray(totals)
+        return totals
 
     def evaluate(self, dataset_val) -> float:
-        totals = self._eval_totals(dataset_val)
+        totals = np.asarray(self._eval_totals_device(dataset_val))
         print(_eval_line(totals))
         return float(100.0 * totals[1] / max(totals[3], 1.0))
 

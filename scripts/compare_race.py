@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Render the reference-race report: JAX framework vs the actual reference
+implementation (torch-CPU, ``scripts/torch_reference_race.py``) on the
+identical protocol — per-task cumulative top-1, weight-alignment γ, the
+per-slice accuracy matrix, and avg incremental top-1, with deltas and a
+stated tolerance verdict (r4 verdict Next #1).
+
+Usage:
+    python scripts/compare_race.py experiments/race_jax.jsonl \
+        experiments/race_torch.jsonl > RACE.md
+
+Tolerances (stated up front, not fitted to the result): the two sides share
+data, task splits, class order, batch math, herding semantics and
+hyperparameters but draw independent RNG streams (init, shuffles,
+augmentation), so agreement is trajectory-level, not bitwise.  We call the
+race a PASS when cumulative per-task top-1 agrees within 3.0 points at
+every task, γ within 0.10 at every alignment, and avg incremental top-1
+within 2.0 points — tighter than the gap that would indicate an algorithmic
+divergence (a missing KD term, a wrong alignment, broken rehearsal shift
+trajectories by tens of points on this recipe; see the calibration pilots
+in experiments/calib/).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+TOL_TASK = 3.0
+TOL_GAMMA = 0.10
+TOL_AVG = 2.0
+
+
+def load(path):
+    tasks, final, meta = [], None, {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "task":
+                tasks.append(rec)
+            elif rec.get("type") == "final":
+                final = rec
+            elif rec.get("type") == "run":
+                meta = rec
+    return tasks, final, meta
+
+
+def main(jax_path, torch_path):
+    jt, jf, jm = load(jax_path)
+    tt, tf, tm = load(torch_path)
+    if len(jt) != len(tt):
+        sys.exit(
+            f"task count mismatch: {jax_path} has {len(jt)}, "
+            f"{torch_path} has {len(tt)}"
+        )
+
+    print("# RACE — this framework vs the reference implementation\n")
+    print(
+        "End-to-end behavioral race (r4 verdict Next #1): the **actual "
+        "reference algorithm** — its own `resnet.py` backbone driven by a "
+        "faithful torch-CPU restatement of `template.py:226-303` "
+        "(`scripts/torch_reference_race.py`) — against this framework's "
+        "`train.py`, on identical data, task splits, class order, "
+        "hyperparameters, and herding semantics.  The sides share no "
+        "compute code: torch autograd/BN/SGD vs JAX/XLA, PIL-style numpy "
+        "augmentation vs on-device vmapped augmentation.  RNG streams are "
+        "independent, so the comparison is trajectory-level.\n"
+    )
+    print(f"- JAX side:   `{jax_path}` — config `{json.dumps(jm, sort_keys=True)}`")
+    print(f"- torch side: `{torch_path}` — config `{json.dumps(tm, sort_keys=True)}`\n")
+    print(
+        f"Stated tolerances: per-task cumulative top-1 within {TOL_TASK} "
+        f"points, γ within {TOL_GAMMA}, avg incremental within {TOL_AVG} "
+        "points (see script docstring for why).\n"
+    )
+
+    print("| task | jax top-1 | torch top-1 | Δ | jax γ | torch γ | Δγ |")
+    print("|---|---|---|---|---|---|---|")
+    ok = True
+    for j, t in zip(jt, tt):
+        d = j["acc1"] - t["acc1"]
+        ok &= abs(d) <= TOL_TASK
+        if j.get("gamma") is not None and t.get("gamma") is not None:
+            dg = j["gamma"] - t["gamma"]
+            ok &= abs(dg) <= TOL_GAMMA
+            gcells = f"{j['gamma']:.4f} | {t['gamma']:.4f} | {dg:+.4f}"
+        else:
+            gcells = "— | — | —"
+        print(
+            f"| {j['task_id']} | {j['acc1']:.2f} | {t['acc1']:.2f} | "
+            f"{d:+.2f} | {gcells} |"
+        )
+
+    if jf and tf:
+        da = jf["avg_incremental_acc1"] - tf["avg_incremental_acc1"]
+        ok &= abs(da) <= TOL_AVG
+        print(
+            f"\n**avg incremental top-1: jax "
+            f"{jf['avg_incremental_acc1']:.3f} vs torch "
+            f"{tf['avg_incremental_acc1']:.3f} (Δ {da:+.3f})**\n"
+        )
+    else:
+        ok = False
+        print("\n(one side did not complete — no `final` record)\n")
+
+    # Per-slice accuracy matrix deltas: where forgetting happens must match,
+    # not just the cumulative number.
+    if all("acc_per_task" in r for r in jt + tt):
+        T = len(jt)
+        print("per-slice Δ(top-1) (jax − torch), row = after task t:\n")
+        print("| after task | " + " | ".join(f"j={j}" for j in range(T)) + " |")
+        print("|---|" + "---|" * T)
+        worst = 0.0
+        for j, t in zip(jt, tt):
+            ds = [a - b for a, b in zip(j["acc_per_task"], t["acc_per_task"])]
+            worst = max(worst, max(abs(x) for x in ds))
+            cells = [f"{x:+.2f}" for x in ds] + ["—"] * (T - len(ds))
+            print(f"| {j['task_id']} | " + " | ".join(cells) + " |")
+        print(
+            f"\nworst per-slice disagreement: {worst:.2f} points (slices "
+            "are 10-class groups — noisier than the cumulative number; "
+            "reported, not gated)\n"
+        )
+
+    print(
+        f"**VERDICT: {'PASS' if ok else 'FAIL'}** — "
+        + (
+            "the integrated trajectories agree within the stated "
+            "tolerances; every component-level parity claim survives "
+            "end-to-end composition."
+            if ok
+            else "at least one metric exceeds its stated tolerance; see "
+            "the deltas above."
+        )
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        sys.exit("usage: compare_race.py <jax.jsonl> <torch.jsonl>")
+    main(sys.argv[1], sys.argv[2])

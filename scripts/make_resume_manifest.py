@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Replace the committed orbax checkpoint blob tree with reviewable evidence
+(r4 verdict Weak #6 / Next #8): a sha256 manifest of every checkpoint file
+plus the JSONL twin-equality check — the resumed run's post-resume task
+records must match the uninterrupted twin bit-for-bit on every accuracy and
+γ (wall-clock/compile columns legitimately differ).
+
+Usage:
+    python scripts/make_resume_manifest.py experiments/ckpt_b50_resume \
+        experiments/b50_inc10_synthetic_hard128_aa35_mem256.jsonl \
+        experiments/b50_inc10_synthetic_hard128_aa35_mem256_resume.jsonl \
+        > experiments/ckpt_b50_resume_manifest.json
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+
+def file_manifest(root: str):
+    entries = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            entries.append(
+                {
+                    "path": os.path.relpath(path, root),
+                    "bytes": os.path.getsize(path),
+                    "sha256": h.hexdigest(),
+                }
+            )
+    return sorted(entries, key=lambda e: e["path"])
+
+
+def task_records(path: str):
+    records, start = {}, 0
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "resume":
+                start = max(start, rec.get("start_task") or 0)
+            elif rec.get("type") == "task":
+                records[rec["task_id"]] = rec
+    return records, start
+
+
+def main(ckpt_dir: str, twin_path: str, resume_path: str) -> None:
+    twin, _ = task_records(twin_path)
+    resumed, start = task_records(resume_path)
+    comparisons = []
+    equal = True
+    for tid in sorted(resumed):
+        if tid < start:
+            continue  # pre-crash segment; the twin check covers post-resume
+        a, b = twin.get(tid), resumed[tid]
+        same = (
+            a is not None
+            and a["acc1"] == b["acc1"]
+            and a.get("gamma") == b.get("gamma")
+            and a.get("acc1s") == b.get("acc1s")
+        )
+        equal &= same
+        comparisons.append(
+            {
+                "task_id": tid,
+                "twin_acc1": None if a is None else a["acc1"],
+                "resumed_acc1": b["acc1"],
+                "twin_gamma": None if a is None else a.get("gamma"),
+                "resumed_gamma": b.get("gamma"),
+                "bitwise_equal": same,
+            }
+        )
+
+    files = file_manifest(ckpt_dir)
+    json.dump(
+        {
+            "what": (
+                "sha256 manifest of the orbax checkpoint tree used for the "
+                "live SIGKILL-and-resume evidence, plus the JSONL twin "
+                "equality check; replaces the previously committed binary "
+                "tree (r4 verdict Weak #6)"
+            ),
+            "ckpt_dir": ckpt_dir,
+            "nb_files": len(files),
+            "total_bytes": sum(e["bytes"] for e in files),
+            "files": files,
+            "twin_log": twin_path,
+            "resume_log": resume_path,
+            "resume_start_task": start,
+            "post_resume_comparison": comparisons,
+            "post_resume_bitwise_equal": equal,
+        },
+        sys.stdout,
+        indent=1,
+    )
+    print()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 4:
+        sys.exit("usage: make_resume_manifest.py <ckpt_dir> <twin.jsonl> <resume.jsonl>")
+    main(*sys.argv[1:])

@@ -125,7 +125,11 @@ def main(paths):
         "exact, so their accuracy and γ columns must match the "
         "uninterrupted twin run bit-for-bit (the wall-clock/compile "
         "columns legitimately differ) — live preemption-recovery "
-        "evidence, not a separate configuration.\n"
+        "evidence, not a separate configuration. The checkpoint tree "
+        "behind the resume is recorded as a sha256 manifest + twin "
+        "equality check (`experiments/ckpt_b50_resume_manifest.json`, "
+        "`scripts/make_resume_manifest.py`) instead of committed "
+        "binary blobs.\n"
     )
     print(
         "Context for reading the tables: (1) No real CIFAR-100/ImageNet "
