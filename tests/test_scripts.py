@@ -1,6 +1,7 @@
 """Evidence-pipeline tests: scripts/summarize_results.py renders RESULTS.md
 from JSONL logs — resume-marker segment filtering, compile-overhead
-derivation, and the table render itself (the artifact the judge reads)."""
+derivation, and the table render itself (the artifact the judge reads) —
+and scripts/compare_race.py renders the reference-race verdict."""
 
 import importlib.util
 import io
@@ -12,13 +13,17 @@ from contextlib import redirect_stdout
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _mod():
+def _load_script(name):
     spec = importlib.util.spec_from_file_location(
-        "summarize_results", os.path.join(REPO, "scripts", "summarize_results.py")
+        name, os.path.join(REPO, "scripts", f"{name}.py")
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _mod():
+    return _load_script("summarize_results")
 
 
 def _write_jsonl(path, records):
@@ -161,3 +166,45 @@ def test_render_skips_matrix_for_pre_matrix_logs(tmp_path):
     with redirect_stdout(buf):
         m.main([path])
     assert "accuracy matrix" not in buf.getvalue()
+
+
+# --------------------------------------------------------------------------- #
+# compare_race.py — the reference-race verdict renderer
+# --------------------------------------------------------------------------- #
+
+
+def _race_log(path, acc1s, gammas, avg, matrix_rows):
+    records = [{"type": "run", "seed": 0}]
+    for i, (a, g, row) in enumerate(zip(acc1s, gammas, matrix_rows)):
+        records.append(
+            {"type": "task", "task_id": i, "acc1": a, "acc1s": acc1s[: i + 1],
+             "acc_per_task": row, "gamma": g, "nb_new": 10}
+        )
+    records.append({"type": "final", "acc1s": acc1s, "avg_incremental_acc1": avg})
+    _write_jsonl(path, records)
+
+
+def test_compare_race_pass_within_tolerance(tmp_path):
+    m = _load_script("compare_race")
+    a, b = str(tmp_path / "jax.jsonl"), str(tmp_path / "torch.jsonl")
+    _race_log(a, [99.0, 95.0], [None, 0.96], 97.0, [[99.0], [93.0, 97.0]])
+    _race_log(b, [98.0, 93.5], [None, 0.92], 95.75, [[98.0], [91.0, 96.0]])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main(a, b)
+    out = buf.getvalue()
+    assert "**VERDICT: PASS**" in out
+    assert "| 1 | 95.00 | 93.50 | +1.50 | 0.9600 | 0.9200 | +0.0400 |" in out
+    assert "worst per-slice disagreement: 2.00" in out
+
+
+def test_compare_race_fails_beyond_tolerance(tmp_path):
+    m = _load_script("compare_race")
+    a, b = str(tmp_path / "jax.jsonl"), str(tmp_path / "torch.jsonl")
+    # 8-point task-1 gap: an algorithmic divergence must not pass.
+    _race_log(a, [99.0, 95.0], [None, 0.96], 97.0, [[99.0], [93.0, 97.0]])
+    _race_log(b, [98.0, 87.0], [None, 0.96], 92.5, [[98.0], [80.0, 94.0]])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main(a, b)
+    assert "**VERDICT: FAIL**" in buf.getvalue()
