@@ -7,7 +7,14 @@ stated tolerance verdict (r4 verdict Next #1).
 
 Usage:
     python scripts/compare_race.py experiments/race_jax.jsonl \
-        experiments/race_torch.jsonl > RACE.md
+        experiments/race_torch.jsonl [experiments/race_torch_seed1.jsonl] \
+        > RACE.md
+
+The optional third log is a SECOND SEED of the torch side: it measures the
+same-implementation seed-to-seed spread of this protocol, the only honest
+yardstick for whether a cross-implementation delta means anything.  The
+strict gates below stay a-priori; the noise section is reported separately
+and never edits the verdict.
 
 Tolerances (stated up front, not fitted to the result): the two sides share
 data, task splits, class order, batch math, herding semantics and
@@ -48,7 +55,7 @@ def load(path):
     return tasks, final, meta
 
 
-def main(jax_path, torch_path):
+def main(jax_path, torch_path, noise_path=None):
     jt, jf, jm = load(jax_path)
     tt, tf, tm = load(torch_path)
     if len(jt) != len(tt):
@@ -137,8 +144,79 @@ def main(jax_path, torch_path):
         )
     )
 
+    if noise_path:
+        nt, nf, nm = load(noise_path)
+        if len(nt) != len(tt):
+            # A truncated second-seed log would make the spread and the
+            # cross deltas cover different task ranges — refuse rather
+            # than print a miscalibrated yardstick.
+            sys.exit(
+                f"noise log task count mismatch: {noise_path} has "
+                f"{len(nt)}, {torch_path} has {len(tt)}"
+            )
+        print(
+            "\n## Seed-noise yardstick (same implementation, second seed)\n"
+        )
+        print(
+            f"`{noise_path}` re-runs the **torch reference side itself** "
+            f"with seed {nm.get('seed')} — every delta below is two runs "
+            "of the *same* code differing only in RNG, i.e. the protocol's "
+            "intrinsic run-to-run spread:\n"
+        )
+        print("| task | torch seed0 | torch seed1 | same-impl Δ | cross-impl Δ (jax−torch) |")
+        print("|---|---|---|---|---|")
+        spread = 0.0
+        for t, n_rec, j in zip(tt, nt, jt):
+            ds = t["acc1"] - n_rec["acc1"]
+            spread = max(spread, abs(ds))
+            print(
+                f"| {t['task_id']} | {t['acc1']:.2f} | {n_rec['acc1']:.2f} "
+                f"| {ds:+.2f} | {j['acc1'] - t['acc1']:+.2f} |"
+            )
+        worst_cross = max(abs(j["acc1"] - t["acc1"]) for j, t in zip(jt, tt))
+        if jf and tf and nf:
+            avgs = sorted(
+                [tf["avg_incremental_acc1"], nf["avg_incremental_acc1"]]
+            )
+            jx = jf["avg_incremental_acc1"]
+            inside = avgs[0] <= jx <= avgs[1]
+            print(
+                f"\navg incremental top-1: torch seeds span "
+                f"[{avgs[0]:.3f}, {avgs[1]:.3f}]; the jax run lands at "
+                f"{jx:.3f} — {'INSIDE' if inside else 'outside'} the "
+                "reference's own seed band.\n"
+            )
+        cross = [j["acc1"] - t["acc1"] for j, t in zip(jt, tt)]
+        oscillates = any(d > 0 for d in cross) and any(d < 0 for d in cross)
+        sign_clause = (
+            ", and the deltas oscillate in sign (no side consistently "
+            "ahead) — what seed noise looks like, not what an algorithmic "
+            "divergence (missing KD/alignment/rehearsal) looks like: those "
+            "shift trajectories by tens of points, always in one direction"
+            if oscillates
+            else "; note the deltas share one sign across tasks, so a "
+            "small systematic offset cannot be ruled out at single-run "
+            "resolution"
+        )
+        print(
+            f"\nmax same-implementation spread: {spread:.2f} points; "
+            f"max cross-implementation delta: {worst_cross:.2f} points. "
+            + (
+                "The cross-implementation deltas are within ~the "
+                "same-implementation seed spread — the strict per-task "
+                "gate above is tighter than this protocol's intrinsic "
+                "noise at single-run resolution" + sign_clause + "."
+                if worst_cross <= spread * 1.5
+                else "The cross-implementation deltas EXCEED the measured "
+                "seed spread — evidence of a systematic behavioral "
+                "difference worth diagnosing."
+            )
+        )
+
 
 if __name__ == "__main__":
-    if len(sys.argv) != 3:
-        sys.exit("usage: compare_race.py <jax.jsonl> <torch.jsonl>")
-    main(sys.argv[1], sys.argv[2])
+    if len(sys.argv) not in (3, 4):
+        sys.exit(
+            "usage: compare_race.py <jax.jsonl> <torch.jsonl> [torch_seed2.jsonl]"
+        )
+    main(*sys.argv[1:])

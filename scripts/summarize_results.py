@@ -119,6 +119,15 @@ def main(paths):
         "2000/50000 ≈ 4% rehearsal pressure, RandAugment on, σ=128 noise): "
         "the trajectory shows real forgetting and the WA γ correction "
         "(γ<1 pulls the over-normed new head down each task).\n\n"
+        "Round-5 additions: `*_mesh8` is the same dynamics protocol run "
+        "on an **8-device mesh** (`--host_devices 8`, global batch 128 = "
+        "8 × 16 per device) — its trajectory must track the 1-device twin "
+        "up to float reduction order, proving the distributed task loop "
+        "(sharded loader, global-batch BN, replicated herding) at protocol "
+        "scale. `*_bf16` is the twin with `--compute_dtype bfloat16` (the "
+        "TPU recipe's dtype); its accuracy delta vs the f32 twin prices "
+        "the bf16 decision before chip time. `race_jax`/`race_torch` are "
+        "the two sides of the end-to-end reference race (see `RACE.md`).\n\n"
         "Runs suffixed `_resume` were SIGKILLed mid-task and relaunched "
         "with `--resume` from their orbax checkpoints (the `resume` marker "
         "in the JSONL records the restart point); task-boundary resume is "

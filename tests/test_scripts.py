@@ -208,3 +208,26 @@ def test_compare_race_fails_beyond_tolerance(tmp_path):
     with redirect_stdout(buf):
         m.main(a, b)
     assert "**VERDICT: FAIL**" in buf.getvalue()
+
+
+def test_compare_race_noise_yardstick(tmp_path):
+    m = _load_script("compare_race")
+    a = str(tmp_path / "jax.jsonl")
+    b = str(tmp_path / "torch.jsonl")
+    c = str(tmp_path / "torch_s1.jsonl")
+    _race_log(a, [99.0, 90.0], [None, 0.96], 94.5, [[99.0], [85.0, 95.0]])
+    _race_log(b, [98.0, 85.0], [None, 0.92], 91.5, [[98.0], [75.0, 95.0]])
+    _race_log(c, [99.2, 89.5], [None, 0.95], 94.35, [[99.2], [84.0, 95.0]])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main(a, b, c)
+    out = buf.getvalue()
+    # Task-1 cross delta (5.0) exceeds the strict gate -> verdict FAIL ...
+    assert "**VERDICT: FAIL**" in out
+    # ... and the noise section reports both spreads side by side.
+    assert "Seed-noise yardstick" in out
+    assert "| 1 | 85.00 | 89.50 | -4.50 | +5.00 |" in out
+    assert "max same-implementation spread: 4.50" in out
+    assert "max cross-implementation delta: 5.00" in out
+    # 5.0 <= 1.5 * 4.5 -> noise-magnitude wording, not divergence wording.
+    assert "intrinsic" in out and "EXCEED" not in out
