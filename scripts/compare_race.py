@@ -7,14 +7,16 @@ stated tolerance verdict (r4 verdict Next #1).
 
 Usage:
     python scripts/compare_race.py experiments/race_jax.jsonl \
-        experiments/race_torch.jsonl [experiments/race_torch_seed1.jsonl] \
-        > RACE.md
+        experiments/race_torch.jsonl [experiments/race_torch_seed1.jsonl \
+        [experiments/race_jax_seed1.jsonl]] > RACE.md
 
 The optional third log is a SECOND SEED of the torch side: it measures the
 same-implementation seed-to-seed spread of this protocol, the only honest
 yardstick for whether a cross-implementation delta means anything.  The
-strict gates below stay a-priori; the noise section is reported separately
-and never edits the verdict.
+optional fourth log is a second seed of the JAX side, completing the 2x2:
+both implementations' seed bands are rendered and checked for overlap.
+The strict gates below stay a-priori; the noise sections are reported
+separately and never edit the verdict.
 
 Tolerances (stated up front, not fitted to the result): the two sides share
 data, task splits, class order, batch math, herding semantics and
@@ -55,7 +57,20 @@ def load(path):
     return tasks, final, meta
 
 
-def main(jax_path, torch_path, noise_path=None):
+def load_checked(path, expected_len, other_path):
+    """Load a second-seed log and refuse a task-count mismatch — a
+    truncated log would make the spread and the cross deltas cover
+    different task ranges, a miscalibrated yardstick."""
+    tasks, final, meta = load(path)
+    if len(tasks) != expected_len:
+        sys.exit(
+            f"task count mismatch: {path} has {len(tasks)}, "
+            f"{other_path} has {expected_len}"
+        )
+    return tasks, final, meta
+
+
+def main(jax_path, torch_path, noise_path=None, jax_noise_path=None):
     jt, jf, jm = load(jax_path)
     tt, tf, tm = load(torch_path)
     if len(jt) != len(tt):
@@ -145,15 +160,7 @@ def main(jax_path, torch_path, noise_path=None):
     )
 
     if noise_path:
-        nt, nf, nm = load(noise_path)
-        if len(nt) != len(tt):
-            # A truncated second-seed log would make the spread and the
-            # cross deltas cover different task ranges — refuse rather
-            # than print a miscalibrated yardstick.
-            sys.exit(
-                f"noise log task count mismatch: {noise_path} has "
-                f"{len(nt)}, {torch_path} has {len(tt)}"
-            )
+        nt, nf, nm = load_checked(noise_path, len(tt), torch_path)
         print(
             "\n## Seed-noise yardstick (same implementation, second seed)\n"
         )
@@ -213,10 +220,53 @@ def main(jax_path, torch_path, noise_path=None):
             )
         )
 
+    if jax_noise_path:
+        if not noise_path:
+            # Without the torch second seed there is no torch band; a
+            # degenerate single-run "band" would misrepresent the data.
+            sys.exit("jax_seed2 requires torch_seed2 (the 2x2 needs both)")
+        jnt, jnf, jnm = load_checked(jax_noise_path, len(jt), jax_path)
+        print("\n## Both seed bands (2×2)\n")
+        print(
+            f"`{jax_noise_path}` is the JAX side at seed "
+            f"{jnm.get('seed')} — with two seeds per implementation, the "
+            "per-task bands can be compared directly:\n"
+        )
+        print(
+            "| task | jax band | torch band | bands overlap |"
+        )
+        print("|---|---|---|---|")
+        overlaps = 0
+        for j, jn, t, n_rec in zip(jt, jnt, tt, nt):
+            jlo, jhi = sorted([j["acc1"], jn["acc1"]])
+            tlo, thi = sorted([t["acc1"], n_rec["acc1"]])
+            ov = jlo <= thi and tlo <= jhi
+            overlaps += ov
+            print(
+                f"| {j['task_id']} | [{jlo:.2f}, {jhi:.2f}] | "
+                f"[{tlo:.2f}, {thi:.2f}] | {'yes' if ov else 'no'} |"
+            )
+        if jf and jnf and tf and nf:
+            jb = sorted([jf["avg_incremental_acc1"], jnf["avg_incremental_acc1"]])
+            tb = sorted([tf["avg_incremental_acc1"], nf["avg_incremental_acc1"]])
+            ov = jb[0] <= tb[1] and tb[0] <= jb[1]
+            print(
+                f"\navg incremental: jax band [{jb[0]:.3f}, {jb[1]:.3f}] vs "
+                f"torch band [{tb[0]:.3f}, {tb[1]:.3f}] — "
+                f"{'overlapping' if ov else 'disjoint'}. "
+            )
+        print(
+            f"\n{overlaps}/{len(jt)} per-task bands overlap (two-seed "
+            "bands understate the true spread, so non-overlap at a task "
+            "is weak evidence by itself; the avg-incremental bands and "
+            "the oscillation analysis above carry the conclusion)."
+        )
+
 
 if __name__ == "__main__":
-    if len(sys.argv) not in (3, 4):
+    if len(sys.argv) not in (3, 4, 5):
         sys.exit(
-            "usage: compare_race.py <jax.jsonl> <torch.jsonl> [torch_seed2.jsonl]"
+            "usage: compare_race.py <jax.jsonl> <torch.jsonl> "
+            "[torch_seed2.jsonl [jax_seed2.jsonl]]"
         )
     main(*sys.argv[1:])

@@ -231,3 +231,25 @@ def test_compare_race_noise_yardstick(tmp_path):
     assert "max cross-implementation delta: 5.00" in out
     # 5.0 <= 1.5 * 4.5 -> noise-magnitude wording, not divergence wording.
     assert "intrinsic" in out and "EXCEED" not in out
+
+
+def test_compare_race_two_by_two_bands(tmp_path):
+    m = _load_script("compare_race")
+    a = str(tmp_path / "jax.jsonl")
+    a1 = str(tmp_path / "jax_s1.jsonl")
+    b = str(tmp_path / "torch.jsonl")
+    b1 = str(tmp_path / "torch_s1.jsonl")
+    _race_log(a, [99.0, 92.0], [None, 0.96], 95.5, [[99.0], [89.0, 95.0]])
+    _race_log(a1, [98.6, 94.0], [None, 0.97], 96.3, [[98.6], [91.0, 97.0]])
+    _race_log(b, [98.0, 91.0], [None, 0.92], 94.5, [[98.0], [87.0, 95.0]])
+    _race_log(b1, [99.1, 93.0], [None, 0.95], 96.05, [[99.1], [89.0, 97.0]])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main(a, b, b1, a1)
+    out = buf.getvalue()
+    assert "Both seed bands (2×2)" in out
+    # Task 0: jax [98.60, 99.00] vs torch [98.00, 99.10] -> overlap.
+    assert "| 0 | [98.60, 99.00] | [98.00, 99.10] | yes |" in out
+    assert "2/2 per-task bands overlap" in out
+    assert "avg incremental: jax band [95.500, 96.300] vs torch band " \
+           "[94.500, 96.050] — overlapping." in out
