@@ -147,6 +147,14 @@ class CilConfig:
     profile_dir: Optional[str] = None  # trace each task's first epoch
     log_file: Optional[str] = None  # structured JSONL experiment log
 
+    # Telemetry (spans + counters + heartbeat; telemetry/ package)
+    telemetry_dir: Optional[str] = None  # span JSONL + Perfetto export dir;
+    # also defaults log_file to <dir>/run.jsonl and the heartbeat to
+    # <dir>/heartbeat.json when those are unset
+    heartbeat_path: Optional[str] = None  # liveness JSON consumed by
+    # scripts/tpu_watchdog.sh (atomic rewrite on a cadence)
+    heartbeat_interval_s: float = 15.0
+
     # ------------------------------------------------------------------ #
 
     def increments(self, nb_classes: int) -> Tuple[int, ...]:
@@ -246,6 +254,19 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of each task's first epoch")
     p.add_argument("--log_file", default=None, type=str,
                    help="write a structured JSONL experiment log")
+    p.add_argument("--telemetry_dir", default=None, type=str,
+                   help="write host-side span telemetry (spans.jsonl + "
+                   "Perfetto trace.json) here; also defaults --log_file to "
+                   "<dir>/run.jsonl and --heartbeat_path to "
+                   "<dir>/heartbeat.json when those are unset")
+    p.add_argument("--heartbeat_path", default=None, type=str,
+                   help="liveness heartbeat JSON, atomically rewritten every "
+                   "--heartbeat_interval_s; consumed by "
+                   "scripts/tpu_watchdog.sh instead of blind chip probing")
+    p.add_argument("--heartbeat_interval_s", default=d.heartbeat_interval_s,
+                   type=float,
+                   help="heartbeat cadence; the file is guaranteed fresher "
+                   "than 2x this during a live run")
     p.add_argument("--bn_group_size", default=0, type=int,
                    help="BatchNorm statistics group size (0 = global batch; "
                    "128 = reference per-GPU parity)")
@@ -318,4 +339,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         resume=args.resume,
         profile_dir=args.profile_dir,
         log_file=args.log_file,
+        telemetry_dir=args.telemetry_dir,
+        heartbeat_path=args.heartbeat_path,
+        heartbeat_interval_s=args.heartbeat_interval_s,
     )

@@ -22,6 +22,7 @@ Design points:
 
 from __future__ import annotations
 
+import time
 from typing import Iterator, Tuple
 
 import numpy as np
@@ -53,11 +54,15 @@ def train_batches(
     seed: int,
     process_index: int = 0,
     process_count: int = 1,
+    clock=None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Shuffled fixed-shape ``(x uint8, y)`` batches for one epoch.
 
     ``batch_size`` is the **global** batch; this process yields its
-    ``batch_size // process_count`` stripe of every batch.
+    ``batch_size // process_count`` stripe of every batch.  ``clock`` (a
+    ``telemetry.StallClock``) charges the host-side production cost of each
+    batch — the index arithmetic and the uint8 row gather — to the input-
+    pipeline stall account, so data-bound epochs are measurable, not guessed.
     """
     n = len(task)
     perm = _epoch_perm(seed, n)
@@ -65,9 +70,13 @@ def train_batches(
     padded = np.resize(perm, nb_batches * batch_size)
     per_proc = _per_process(batch_size, process_count)
     for b in range(nb_batches):
+        t0 = time.perf_counter()
         idx = padded[b * batch_size : (b + 1) * batch_size]
         idx = idx[process_index * per_proc : (process_index + 1) * per_proc]
-        yield gather_rows(task.x, idx), task.y[idx]
+        batch = gather_rows(task.x, idx), task.y[idx]
+        if clock is not None:
+            clock.add_host(time.perf_counter() - t0)
+        yield batch
 
 
 def eval_batches(
@@ -75,17 +84,22 @@ def eval_batches(
     batch_size: int,
     process_index: int = 0,
     process_count: int = 1,
+    clock=None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Sequential ``(x, y, weight)`` batches; padding rows carry weight 0."""
     n = len(task)
     per_proc = _per_process(batch_size, process_count)
     nb_batches = -(-n // batch_size)
     for b in range(nb_batches):
+        t0 = time.perf_counter()
         idx = np.arange(b * batch_size, (b + 1) * batch_size)
         w = (idx < n).astype(np.float32)
         idx = np.minimum(idx, n - 1)
         sl = slice(process_index * per_proc, (process_index + 1) * per_proc)
-        yield gather_rows(task.x, idx[sl]), task.y[idx[sl]], w[sl]
+        batch = gather_rows(task.x, idx[sl]), task.y[idx[sl]], w[sl]
+        if clock is not None:
+            clock.add_host(time.perf_counter() - t0)
+        yield batch
 
 
 def sequential_batches(

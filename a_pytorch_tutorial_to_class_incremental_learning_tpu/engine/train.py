@@ -128,6 +128,9 @@ def _make_step_core(
     pallas_loss = use_pallas_loss and backend in ("tpu", "cpu")
     pallas_sharded = pallas_loss and mesh is not None and mesh.size > 1
 
+    # jax.named_scope threads the phase names into XLA metadata, so device
+    # profiler traces and the host-side span tracer (telemetry/spans.py)
+    # speak the same phase vocabulary.
     def step(
         state: TrainState,
         teacher: Optional[Teacher],
@@ -137,16 +140,18 @@ def _make_step_core(
         lr: jax.Array,
         lambda_kd: jax.Array,
     ):
-        x = train_augment(key, x_u8, aug_cfg)
+        with jax.named_scope("augment"):
+            x = train_augment(key, x_u8, aug_cfg)
 
         def loss_fn(params):
-            (logits, _feats), mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                x,
-                num_active=state.num_active,
-                train=True,
-                mutable=["batch_stats"],
-            )
+            with jax.named_scope("student_forward"):
+                (logits, _feats), mutated = model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    x,
+                    num_active=state.num_active,
+                    train=True,
+                    mutable=["batch_stats"],
+                )
             if pallas_sharded:
                 from ..ops import sharded_fused_masked_cross_entropy
 
@@ -171,15 +176,17 @@ def _make_step_core(
             else:
                 ce = cross_entropy(logits, labels, state.num_active, label_smoothing)
             if has_teacher:
-                t_logits, _ = model.apply(
-                    {"params": teacher.params, "batch_stats": teacher.batch_stats},
-                    x,
-                    num_active=teacher.known,
-                    train=False,
-                )
-                kd = lambda_kd * soft_target_kd(
-                    logits, t_logits, state.known, kd_temperature
-                )
+                with jax.named_scope("teacher_kd"):
+                    t_logits, _ = model.apply(
+                        {"params": teacher.params,
+                         "batch_stats": teacher.batch_stats},
+                        x,
+                        num_active=teacher.known,
+                        train=False,
+                    )
+                    kd = lambda_kd * soft_target_kd(
+                        logits, t_logits, state.known, kd_temperature
+                    )
             else:
                 kd = jnp.float32(0.0)
             return ce + kd, (mutated["batch_stats"], logits, ce, kd)
@@ -190,9 +197,10 @@ def _make_step_core(
         # Mutable apply may hand back a FrozenDict; the scan carry (and the
         # donated TrainState) must keep one stable pytree type.
         new_stats = unfreeze(new_stats)
-        new_params, new_buf = sgd_update(
-            state.params, grads, state.momentum, lr, momentum, weight_decay
-        )
+        with jax.named_scope("sgd_update"):
+            new_params, new_buf = sgd_update(
+                state.params, grads, state.momentum, lr, momentum, weight_decay
+            )
         acc1, acc5 = accuracy(logits, labels, topk=(1, 5))
         new_state = state.replace(
             params=new_params, batch_stats=new_stats, momentum=new_buf

@@ -91,6 +91,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicated_scalar(mesh: Mesh, value, dtype=None):
+    """An int32 (or ``dtype``) scalar committed to the replicated mesh sharding.
+
+    Scalar TrainState leaves must be created like this, not as bare
+    ``jnp.int32(...)``: an uncommitted single-device scalar and the committed
+    mesh-replicated scalar a jitted program hands back are different cache
+    keys, so a bare scalar makes every program that carries it through
+    (train step, fused epoch) silently compile twice — once for the fresh
+    state, once for its own output.
+    """
+    import jax.numpy as jnp
+
+    return jax.device_put(
+        jnp.asarray(value, dtype or jnp.int32), replicated(mesh)
+    )
+
+
 # Exact param-path components that carry a class dimension as their last axis
 # (the CilModel masked head, models/cil_model.py); sharded over the model axis.
 _CLASS_DIM_PARAMS = ("fc_kernel", "fc_bias")

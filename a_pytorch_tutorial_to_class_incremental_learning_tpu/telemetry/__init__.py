@@ -1,0 +1,87 @@
+"""Unified telemetry: spans, recompile/HBM/stall counters, heartbeat, CIL
+metrics.
+
+The reference's only output channel is rank-0 stdout; this package gives the
+task loop the observability a TPU-scale system treats as table stakes — see
+the module docstrings of :mod:`.spans`, :mod:`.counters`, :mod:`.heartbeat`,
+:mod:`.cil_metrics`.  Everything funnels into the one :class:`~..utils.
+logging.Sink` record vocabulary validated by
+``scripts/check_telemetry_schema.py`` and rendered by
+``scripts/report_run.py``.
+
+:class:`Telemetry` is the facade the engine threads through the loop; with no
+``telemetry_dir``/``heartbeat_path`` configured every call is a no-op, so the
+hot path carries no conditional clutter.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.logging import NullSink, Sink
+from .cil_metrics import (  # noqa: F401
+    AccuracyMatrix,
+    average_incremental_accuracy,
+    backward_transfer,
+    per_task_forgetting,
+)
+from .counters import RecompileMonitor, StallClock, clocked, hbm_stats  # noqa: F401
+from .heartbeat import Heartbeat, read_heartbeat  # noqa: F401
+from .spans import SpanTracer, coverage, load_spans  # noqa: F401
+
+
+class Telemetry:
+    """One handle over the telemetry subsystem, built from config flags.
+
+    * ``telemetry_dir`` — spans land in ``<dir>/spans.jsonl`` (plus a
+      Chrome-trace export at close); default heartbeat location.
+    * ``heartbeat_path`` — overrides the heartbeat file location (can be
+      enabled without a telemetry dir, e.g. just for the watchdog).
+    * ``sink`` — where counter and metric *records* go; the engine passes
+      its experiment ``JsonlLogger`` so one JSONL stream carries the whole
+      run (sink unification).
+    """
+
+    def __init__(
+        self,
+        telemetry_dir: Optional[str] = None,
+        heartbeat_path: Optional[str] = None,
+        heartbeat_interval_s: float = 15.0,
+        sink: Optional[Sink] = None,
+    ):
+        self.dir = telemetry_dir
+        self.sink = sink or NullSink()
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            if heartbeat_path is None:
+                heartbeat_path = os.path.join(telemetry_dir, "heartbeat.json")
+        self.spans = SpanTracer(
+            os.path.join(telemetry_dir, "spans.jsonl") if telemetry_dir else None
+        )
+        self.heartbeat = Heartbeat(heartbeat_path, heartbeat_interval_s)
+        self.recompiles = RecompileMonitor(self.sink)
+        self.matrix = AccuracyMatrix()
+
+    @property
+    def enabled(self) -> bool:
+        return self.spans.enabled or self.heartbeat.enabled
+
+    def span(self, name: str, **attrs):
+        return self.spans.span(name, **attrs)
+
+    def log_hbm(self, **attrs) -> None:
+        """Sample per-device memory at a task boundary (no-op on XLA:CPU,
+        which reports no memory statistics — absence over invented zeros)."""
+        stats = hbm_stats()
+        if stats:
+            self.sink.log("hbm", devices=stats, **attrs)
+
+    def close(self) -> None:
+        """End of run: stop the heartbeat thread (final beat) and export the
+        Perfetto-compatible trace next to the span JSONL."""
+        self.heartbeat.stop()
+        if self.spans.enabled:
+            self.spans.export_chrome_trace(
+                os.path.join(self.dir, "trace.json")
+            )

@@ -1,0 +1,100 @@
+"""Class-incremental learning metrics from the task x task accuracy matrix.
+
+Row ``t`` of the matrix is the per-slice top-1 after training task ``t``
+(column ``j`` = task ``j``'s own val slice, the same slicing the reference's
+cumulative eval builds on, template.py:229).  From it the standard continual
+-learning decomposition (Chaudhry et al., Lopez-Paz & Ranzato):
+
+* **average incremental accuracy** — mean of the cumulative top-1 after each
+  task (the reference's headline number, template.py:225);
+* **forgetting** per slice ``j`` — best accuracy any earlier row achieved on
+  ``j`` minus the final row's accuracy on ``j`` (how much of task ``j`` was
+  lost, wherever the peak was);
+* **backward transfer (BWT)** — mean over ``j < T-1`` of final minus
+  diagonal accuracy (signed: negative = forgetting, positive = later tasks
+  improved earlier ones).
+
+The same math backs ``engine/loop.py``'s per-task ``cil_metrics`` records and
+``scripts/report_run.py``'s rendering, so the two can never disagree.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+
+def average_incremental_accuracy(acc1s: Sequence[float]) -> float:
+    """Mean cumulative top-1 over tasks (reference template.py:225)."""
+    return float(sum(acc1s) / len(acc1s)) if acc1s else 0.0
+
+
+def per_task_forgetting(matrix: Sequence[Sequence[float]]) -> Optional[List[float]]:
+    """``f_j = max_{t in [j, T-2]} A[t][j] - A[T-1][j]`` for ``j < T-1``.
+
+    None for a matrix with fewer than two complete rows (nothing can have
+    been forgotten yet).
+    """
+    T = len(matrix)
+    if T < 2 or any(len(matrix[t]) != t + 1 for t in range(T)):
+        return None
+    final = matrix[T - 1]
+    return [
+        round(max(matrix[t][j] for t in range(j, T - 1)) - final[j], 5)
+        for j in range(T - 1)
+    ]
+
+
+def backward_transfer(matrix: Sequence[Sequence[float]]) -> Optional[float]:
+    """``BWT = mean_{j < T-1} (A[T-1][j] - A[j][j])`` — signed, negative
+    means net forgetting.  None below two complete rows."""
+    T = len(matrix)
+    if T < 2 or any(len(matrix[t]) != t + 1 for t in range(T)):
+        return None
+    final = matrix[T - 1]
+    return round(
+        sum(final[j] - matrix[j][j] for j in range(T - 1)) / (T - 1), 5
+    )
+
+
+class AccuracyMatrix:
+    """Incrementally built lower-triangular task x task accuracy matrix.
+
+    The loop appends one row per trained task; ``summary()`` derives the
+    metrics valid *at that point* (after task t the matrix's first t+1 rows
+    are a complete protocol prefix, so forgetting/BWT are well defined for
+    it).  Rows are keyed by task id so a resumed run starting mid-protocol
+    degrades to partial=True instead of silently computing wrong metrics —
+    the same rule ``scripts/summarize_results.py`` enforces when rendering.
+    """
+
+    def __init__(self):
+        self.rows: Dict[int, List[float]] = {}
+
+    def add_row(self, task_id: int, acc_per_task: Sequence[float]) -> None:
+        if len(acc_per_task) != task_id + 1:
+            raise ValueError(
+                f"row for task {task_id} must have {task_id + 1} slice "
+                f"accuracies, got {len(acc_per_task)}"
+            )
+        self.rows[task_id] = [float(a) for a in acc_per_task]
+
+    @property
+    def complete(self) -> bool:
+        """True when rows 0..T-1 are all present (no mid-protocol resume
+        into a fresh process without the earlier rows)."""
+        return bool(self.rows) and sorted(self.rows) == list(
+            range(max(self.rows) + 1)
+        )
+
+    def as_list(self) -> List[List[float]]:
+        return [self.rows[t] for t in sorted(self.rows)]
+
+    def summary(self) -> dict:
+        if not self.complete:
+            return {"partial": True, "tasks": sorted(self.rows)}
+        m = self.as_list()
+        return {
+            "nb_tasks": len(m),
+            "forgetting": per_task_forgetting(m),
+            "bwt": backward_transfer(m),
+        }

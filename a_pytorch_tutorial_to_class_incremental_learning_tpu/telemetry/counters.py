@@ -1,0 +1,191 @@
+"""Recompile, HBM, and input-stall counters.
+
+The three numbers TPU-scale training treats as table stakes (pjit/TPUv4
+training systems, arXiv:2204.06514; Podracer, arXiv:2104.06272) and the
+reference has no notion of:
+
+* **recompiles** — an XLA recompile mid-protocol silently costs minutes; the
+  monitor counts jit-cache entries across every tracked executable and warns
+  when the count grows at a point where no new program shape is expected;
+* **HBM** — the grown head, the resident fused-epoch dataset and the teacher
+  snapshot all cost device memory; per-device ``memory_stats()`` sampled at
+  task boundaries shows the trend before an OOM does;
+* **stalls** — per epoch, how much wall time the host spent producing data
+  vs. waiting on the device: data-bound vs. compute-bound, measurable
+  per epoch instead of guessed.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from contextlib import contextmanager
+from typing import Dict, Iterable, Iterator, Optional
+
+from ..utils.logging import NullSink, Sink
+
+
+class StallClock:
+    """Per-epoch host-vs-device wall-time accounting.
+
+    The epoch loop charges every interval to exactly one bucket:
+    ``host`` (batch index math, uint8 gather, host decode, device_put) or
+    ``device`` (step dispatch and the final metrics fetch, i.e. time the
+    host spends waiting on the accelerator).  ``host_s + device_s`` then
+    accounts for ~all of the epoch's wall time (tested to tolerance —
+    the remainder is loop bookkeeping), so ``stall_frac`` =
+    host/(host+device) reads directly as "fraction of the epoch the chip
+    was starved by the input pipeline".
+    """
+
+    def __init__(self):
+        self.host_s = 0.0
+        self.device_s = 0.0
+
+    @contextmanager
+    def host(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.host_s += time.perf_counter() - t0
+
+    @contextmanager
+    def device(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.device_s += time.perf_counter() - t0
+
+    def add_host(self, dt: float) -> None:
+        self.host_s += dt
+
+    @property
+    def stall_frac(self) -> float:
+        total = self.host_s + self.device_s
+        return self.host_s / total if total > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "host_s": round(self.host_s, 4),
+            "device_s": round(self.device_s, 4),
+            "stall_frac": round(self.stall_frac, 4),
+        }
+
+
+def clocked(batches: Iterable, clock: StallClock) -> Iterator:
+    """Charge the production time of each batch to ``clock``'s host bucket.
+
+    Wraps any batch iterator (``data.loader`` generators) so the time spent
+    *inside* ``next()`` — index arithmetic and the uint8 row gather — is
+    separated from the time the consumer spends dispatching device work.
+    """
+    it = iter(batches)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        finally:
+            clock.add_host(time.perf_counter() - t0)
+        yield batch
+
+
+class RecompileMonitor:
+    """Detect unexpected XLA recompiles via jit-cache growth.
+
+    Every jitted callable of the engine is registered with ``track``; the
+    total number of cache entries across them is the number of distinct
+    compiled programs so far.  ``check(...)`` diffs that total against the
+    last check: growth at an *expected* point (the first epoch of a task,
+    which legitimately compiles the task's shapes; anything in task 0) emits
+    a ``recompile`` record; growth anywhere else is the classic silent
+    performance bug — a shape/dtype leak re-triggering compilation mid
+    steady state — and additionally emits a ``recompile_warning`` record
+    plus a Python warning.
+
+    Executables are registered in *groups* (train / eval / feature in the
+    engine) because their legitimate first-compile moments differ: the train
+    programs compile on a task's first epoch, the eval program on the run's
+    first evaluation, the feature program on the first herding pass.  Each
+    ``check`` diffs one group, so an expected eval compile can never mask an
+    unexpected train recompile in the same wall-clock window.
+    """
+
+    def __init__(self, sink: Optional[Sink] = None):
+        self.sink = sink or NullSink()
+        self._fns: Dict[str, object] = {}
+        self._groups: Dict[str, str] = {}
+        self._last: Dict[Optional[str], int] = {}
+
+    def track(self, name: str, fn, group: str = "default") -> None:
+        if hasattr(fn, "_cache_size"):
+            self._fns[name] = fn
+            self._groups[name] = group
+
+    def total(self, group: Optional[str] = None) -> int:
+        return sum(
+            int(fn._cache_size())
+            for name, fn in self._fns.items()
+            if group is None or self._groups[name] == group
+        )
+
+    def check(
+        self, where: str, expected: bool, group: Optional[str] = None, **attrs
+    ) -> int:
+        """Diff the compile count; returns the delta (0 = no new programs)."""
+        total = self.total(group)
+        delta = total - self._last.get(group, 0)
+        self._last[group] = total
+        if group is not None:
+            attrs["group"] = group
+        if delta > 0:
+            self.sink.log(
+                "recompile",
+                where=where,
+                new_programs=delta,
+                total_programs=total,
+                expected=expected,
+                **attrs,
+            )
+            if not expected:
+                self.sink.log(
+                    "recompile_warning",
+                    where=where,
+                    new_programs=delta,
+                    total_programs=total,
+                    **attrs,
+                )
+                warnings.warn(
+                    f"unexpected XLA recompile at {where}: {delta} new "
+                    f"program(s), {total} total — a shape or dtype is "
+                    "changing where the engine promises shape stability",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        return delta
+
+
+def hbm_stats(devices=None) -> Dict[str, Dict[str, int]]:
+    """Per-device memory statistics, keyed by device string.
+
+    TPU/GPU backends report ``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit`` (names vary by PJRT plugin; everything integer-valued is
+    forwarded).  XLA:CPU returns None — then this returns {} and the caller
+    logs nothing, rather than inventing zeros.
+    """
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for d in devices if devices is not None else jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — optional PJRT surface
+            stats = None
+        if stats:
+            out[str(d)] = {
+                k: int(v) for k, v in stats.items() if isinstance(v, (int, float))
+            }
+    return out

@@ -1,0 +1,147 @@
+"""In-process liveness heartbeat.
+
+Round 5's failure mode: the external watchdog probed a dead TPU for an entire
+round (``experiments/tpu_watchdog.log``) because the training process had no
+way to say "I am alive and on task 3 epoch 41".  The fix is the training
+process itself atomically rewriting one small JSON file on a cadence —
+``scripts/tpu_watchdog.sh`` then *reads* that file instead of opening a fresh
+(and potentially chip-wedging) device client to probe.
+
+Contract (consumed by the watchdog and documented in README):
+
+* the file is a single JSON object: ``{"type": "heartbeat", "ts", "seq",
+  "pid", "step", "task", "epoch", "phase", "last_step_ms"}``; ``ts`` is
+  wall-clock seconds, ``seq`` strictly monotonic;
+* it is replaced atomically (write temp + ``os.replace`` on the same
+  filesystem), so a reader never sees a partial write;
+* during a live run its age never exceeds ~2x the configured interval.
+
+Long blocking calls (an XLA compile, a fused-epoch device wait) release the
+GIL, so the optional background thread keeps beating through them — the loop
+only has to ``update()`` the state fields; the thread owns the cadence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Heartbeat:
+    """Atomic heartbeat-file emitter.
+
+    ``update(**state)`` is called from the training loop (cheap: stores the
+    fields and writes only when the interval elapsed).  ``start()`` spawns a
+    daemon thread that keeps writing the latest state every ``interval_s/2``
+    even while the loop is stuck inside one long call; ``stop()`` joins it
+    and writes a final beat.  Disabled (``path=None`` or non-zero process)
+    every method is a no-op.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str],
+        interval_s: float = 15.0,
+        process_index: Optional[int] = None,
+    ):
+        if path is not None and process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        self.enabled = bool(path) and not process_index
+        self.path = path if self.enabled else None
+        self.interval_s = float(interval_s)
+        self._seq = 0
+        self._state = {}
+        self._last_write = 0.0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.path:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            self._write()
+
+    # ------------------------------------------------------------------ #
+
+    def update(self, force: bool = False, **state) -> None:
+        """Record the loop's latest position; write if the cadence is due."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._state.update({k: v for k, v in state.items() if v is not None})
+        now = time.monotonic()
+        if force or now - self._last_write >= self.interval_s:
+            self._write()
+
+    def start(self) -> None:
+        if not self.enabled or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="cil-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=self.interval_s + 5.0)
+            self._thread = None
+        if self.enabled:
+            self._write()  # final beat: the freshest possible "last seen"
+
+    # ------------------------------------------------------------------ #
+
+    def _run(self) -> None:
+        # Half the interval keeps worst-case staleness (a beat just missed
+        # plus a full sleep) under the 2x-interval freshness contract.
+        while not self._stop.wait(self.interval_s / 2.0):
+            self._write()
+
+    def _write(self) -> None:
+        with self._lock:
+            self._seq += 1
+            payload = {
+                "type": "heartbeat",
+                "ts": round(time.time(), 3),
+                "seq": self._seq,
+                "pid": os.getpid(),
+                **self._state,
+            }
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            # Same-directory rename: atomic on POSIX, so a concurrent reader
+            # (the watchdog) sees either the old or the new beat, never a
+            # torn write.
+            os.replace(tmp, self.path)
+            self._last_write = time.monotonic()
+        except OSError:
+            # A full disk must not kill training; staleness is the signal.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def read_heartbeat(path: str, max_age_s: float) -> dict:
+    """Watchdog-side read: the parsed beat plus ``age_s`` and ``fresh``.
+
+    ``fresh`` is False when the file is missing, unparsable, or older than
+    ``max_age_s`` (the contract says 2x the emitter's interval).
+    """
+    try:
+        with open(path) as f:
+            beat = json.load(f)
+        age = time.time() - float(beat["ts"])
+    except (OSError, ValueError, KeyError):
+        return {"fresh": False}
+    beat["age_s"] = round(age, 3)
+    beat["fresh"] = age <= max_age_s
+    return beat

@@ -1,0 +1,154 @@
+"""Host-side span tracer: nested named regions of the task loop.
+
+The ``jax.profiler`` trace answers "what did the *device* do inside one
+epoch"; it is heavyweight (hundreds of MB per minute) and therefore only ever
+wraps task 0's first epoch (``utils/profiling.task_trace``).  This tracer is
+the complement: a lightweight always-on record of what the *host* loop spent
+its wall time on — build scenario, rehearsal inject, head grow, epoch, eval,
+align, herd — cheap enough to run for a whole multi-hour protocol (one dict
+and one JSONL line per region).
+
+Spans nest: each carries its ``depth`` and ``parent`` id, so a reader can
+reconstruct the tree and compute phase coverage (``scripts/report_run.py``
+checks that depth-1 phases cover ~all of the root span's wall time — any gap
+is un-attributed host time, the kind of silent stall this PR exists to make
+visible).  Each span also enters a ``jax.profiler.TraceAnnotation`` so that
+when a device trace *is* active the host phases appear on its timeline.
+
+Export formats: JSONL (one ``span`` record per line, written on span exit so
+a SIGKILL loses at most the open spans) and Chrome ``chrome://tracing`` /
+Perfetto JSON (``export_chrome_trace``), the zero-dependency way to *see*
+the loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Iterator, List, Optional
+
+
+class SpanTracer:
+    """Context-manager span API writing ``span`` records to a JSONL file.
+
+    Disabled (``path=None``) the tracer is a pure no-op; non-zero JAX
+    processes are also silenced so a pod writes one span file, not N.
+    """
+
+    def __init__(self, path: Optional[str], process_index: Optional[int] = None):
+        if path is not None and process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        self.enabled = bool(path) and not process_index
+        self.path = path if self.enabled else None
+        self._stack: List[int] = []
+        self._next_id = 0
+        self.completed: List[dict] = []  # in-memory copy for export/coverage
+        # Monotonic epoch offset: spans are timestamped with the monotonic
+        # clock (immune to NTP steps mid-run) but exported in wall time.
+        self._wall0 = time.time() - time.perf_counter()
+        if self.path:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            open(self.path, "w").close()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        import jax
+
+        span_id = self._next_id
+        self._next_id += 1
+        parent = self._stack[-1] if self._stack else None
+        depth = len(self._stack)
+        self._stack.append(span_id)
+        t0 = time.perf_counter()
+        try:
+            # Compose with the device profiler: when a jax.profiler.trace is
+            # active the host phase shows up on the same timeline.
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        finally:
+            t1 = time.perf_counter()
+            self._stack.pop()
+            rec = {
+                "type": "span",
+                "name": name,
+                "span_id": span_id,
+                "parent": parent,
+                "depth": depth,
+                "ts": round(self._wall0 + t0, 6),
+                "dur_s": round(t1 - t0, 6),
+                **attrs,
+            }
+            self.completed.append(rec)
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    # ------------------------------------------------------------------ #
+    # Analysis / export
+    # ------------------------------------------------------------------ #
+
+    def coverage(self, depth: int = 1) -> Optional[float]:
+        """Fraction of the root span's wall time covered by spans at
+        ``depth`` — the "is any host time unaccounted for?" number."""
+        return coverage(self.completed, depth)
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Write the completed spans as ``chrome://tracing`` / Perfetto JSON
+        (complete-duration ``"X"`` events, microsecond timestamps)."""
+        if not self.enabled:
+            return
+        events = [
+            {
+                "name": rec["name"],
+                "ph": "X",
+                "ts": round(rec["ts"] * 1e6, 1),
+                "dur": round(rec["dur_s"] * 1e6, 1),
+                "pid": 0,
+                "tid": 0,
+                "args": {
+                    k: v
+                    for k, v in rec.items()
+                    if k not in ("type", "name", "ts", "dur_s")
+                },
+            }
+            for rec in self.completed
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+def coverage(spans: List[dict], depth: int = 1) -> Optional[float]:
+    """Phase coverage from span records (tracer-attached or re-loaded from a
+    span JSONL by ``scripts/report_run.py``): sum of ``depth``-level span
+    durations over the total duration of the depth-0 roots.  Siblings at one
+    depth never overlap (the tracer is single-threaded), so the plain sum is
+    the union.  None when there is no root to compare against."""
+    roots = [s for s in spans if s.get("depth") == 0]
+    if not roots:
+        return None
+    total = sum(s["dur_s"] for s in roots)
+    if total <= 0:
+        return None
+    covered = sum(s["dur_s"] for s in spans if s.get("depth") == depth)
+    return covered / total
+
+
+def load_spans(path: str) -> List[dict]:
+    """Read a span JSONL file (tolerating a truncated last line, the normal
+    state after a SIGKILL)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "span":
+                out.append(rec)
+    return out

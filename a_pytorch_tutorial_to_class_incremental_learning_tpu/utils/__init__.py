@@ -1,2 +1,2 @@
-from .logging import MetricLogger, SmoothedValue  # noqa: F401
+from .logging import JsonlLogger, MetricLogger, NullSink, Sink, SmoothedValue  # noqa: F401
 from .platform import force_platform  # noqa: F401

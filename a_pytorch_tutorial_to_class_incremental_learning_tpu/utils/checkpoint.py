@@ -128,7 +128,7 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
     then skips tasks ``<= task_id`` via ``start_task``.
     """
     from ..engine.train import Teacher, sgd_init
-    from ..parallel.mesh import shard_params
+    from ..parallel.mesh import replicated_scalar, shard_params
 
     path = path or latest_task_checkpoint(trainer.config.ckpt_dir or "")
     found_task = -1
@@ -188,15 +188,17 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
         params=params,
         batch_stats=batch_stats,
         momentum=sgd_init(params),
-        num_active=jnp.int32(known),
-        known=jnp.int32(known),
+        # Committed scalars: see replicated_scalar — a bare jnp.int32 here
+        # would cost one silent recompile on the resumed task's second epoch.
+        num_active=replicated_scalar(trainer.mesh, known),
+        known=replicated_scalar(trainer.mesh, known),
     )
     # The post-task model *is* the teacher for the next task
     # (reference template.py:290).
     trainer.teacher = Teacher(
         params=jax.tree_util.tree_map(jnp.copy, params),
         batch_stats=jax.tree_util.tree_map(jnp.copy, batch_stats),
-        known=jnp.int32(known),
+        known=replicated_scalar(trainer.mesh, known),
     )
     trainer.known = known
     trainer.acc1s = list(payload["acc1s"])

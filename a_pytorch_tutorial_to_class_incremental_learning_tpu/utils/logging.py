@@ -99,7 +99,28 @@ class SmoothedValue:
         )
 
 
-class JsonlLogger:
+class Sink:
+    """The one telemetry sink interface: ``log(record_type, **fields)``.
+
+    Everything structured this framework emits — experiment records
+    (run/epoch/task/final), telemetry counters (recompile/hbm), spans,
+    CIL metrics — goes through this surface, so consumers
+    (``scripts/report_run.py``, ``scripts/check_telemetry_schema.py``)
+    see one record vocabulary regardless of which subsystem produced it.
+    """
+
+    def log(self, record_type: str, **fields) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class NullSink(Sink):
+    """Telemetry disabled: swallow every record (keeps call sites branch-free)."""
+
+    def log(self, record_type: str, **fields) -> None:
+        pass
+
+
+class JsonlLogger(Sink):
     """Structured experiment log: one JSON object per line.
 
     The reference's only output channel is rank-0 stdout (SURVEY.md §5

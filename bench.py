@@ -312,6 +312,25 @@ def measure(batch_size: int, iters: int, compute_dtype: str, fused_n: int,
     # Profiler-trace witness: trace_step_ms / agreement / est_mfu_trace
     # (empty on XLA:CPU, which emits no device plane).
     result.update(trace_extras)
+    try:
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+            hbm_stats,
+        )
+
+        hbm = hbm_stats()
+        if hbm:
+            # Peak HBM across devices: the number that says whether the
+            # benched batch even fits at the next size up.  Absent on
+            # XLA:CPU, which reports no memory stats.
+            result["hbm_peak_bytes"] = max(
+                s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))
+                for s in hbm.values()
+            )
+            result["hbm_bytes_in_use"] = max(
+                s.get("bytes_in_use", 0) for s in hbm.values()
+            )
+    except Exception:  # noqa: BLE001 — extras must never break the one-line contract
+        pass
     if flops is not None:
         result["flops_per_step_xla"] = round(flops)
         peak = PEAK_FLOPS.get(backend)

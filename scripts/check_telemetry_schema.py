@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Lint telemetry/experiment JSONL files against the sink record vocabulary.
+
+Usage: python scripts/check_telemetry_schema.py <files...>
+       python scripts/check_telemetry_schema.py experiments/*.jsonl
+
+One schema table covers every record type the unified sink can emit
+(``utils.logging.JsonlLogger`` via ``engine/loop.py`` and the telemetry
+package), plus the span file and the heartbeat file.  A ``.json`` argument is
+treated as a single record (the heartbeat); everything else as JSONL.
+
+The point is drift detection: a producer that renames a field, drops a
+required one, or invents an undeclared record type fails CI here — before a
+consumer (``report_run.py``, ``summarize_results.py``, the watchdog) silently
+renders nothing.
+
+Exit 0 when every record of every file validates; 1 otherwise, with one line
+per violation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+NUM = (int, float)
+
+# type -> (required {field: pytypes}, optional {field: pytypes}, extras)
+# extras: None = no undeclared fields allowed; "any" = any extra field;
+# "numeric" = extra fields allowed if numeric (the epoch record carries
+# whatever meters the train step emits).
+SCHEMA = {
+    "run": ({"data_set": str, "backbone": str, "seed": NUM}, {}, "any"),
+    "resume": ({"start_task": NUM}, {}, None),
+    "epoch": (
+        {"task_id": NUM, "epoch": NUM, "lr": NUM},
+        {
+            "epoch_s": NUM,
+            "host_s": NUM,
+            "device_s": NUM,
+            "stall_frac": NUM,
+        },
+        "numeric",
+    ),
+    "task": (
+        {
+            "task_id": NUM,
+            "acc1": NUM,
+            "acc1s": list,
+            "nb_new": NUM,
+            "known_after": NUM,
+            "seconds": NUM,
+        },
+        {"gamma": (int, float, type(None)), "acc_per_task": list},
+        None,
+    ),
+    "final": (
+        {"acc1s": list, "avg_incremental_acc1": NUM},
+        {
+            "nb_tasks": NUM,
+            "forgetting": (list, type(None)),
+            "bwt": (int, float, type(None)),
+            "partial": bool,
+            "tasks": list,
+        },
+        None,
+    ),
+    "cil_metrics": (
+        {"task_id": NUM, "avg_incremental_acc1": NUM},
+        {
+            "nb_tasks": NUM,
+            "forgetting": (list, type(None)),
+            "bwt": (int, float, type(None)),
+            "partial": bool,
+            "tasks": list,
+        },
+        None,
+    ),
+    "hbm": ({"devices": dict}, {"task_id": NUM}, None),
+    "recompile": (
+        {
+            "where": str,
+            "new_programs": NUM,
+            "total_programs": NUM,
+            "expected": bool,
+        },
+        {"group": str, "task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    "recompile_warning": (
+        {"where": str, "new_programs": NUM, "total_programs": NUM},
+        {"group": str, "task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    "span": (
+        {"name": str, "span_id": NUM, "depth": NUM, "ts": NUM, "dur_s": NUM},
+        {"parent": (int, float, type(None))},
+        "any",  # span attrs (task=, epoch=, ...) ride along freely
+    ),
+    "heartbeat": (
+        {"ts": NUM, "seq": NUM, "pid": NUM},
+        {
+            "step": NUM,
+            "task": NUM,
+            "epoch": NUM,
+            "phase": str,
+            "last_step_ms": NUM,
+            "age_s": NUM,
+            "fresh": bool,
+        },
+        None,
+    ),
+}
+
+# Every JsonlLogger record carries a writer timestamp; spans/heartbeats
+# stamp their own.  "ts" is therefore universally required.
+ALWAYS_REQUIRED = {"ts": NUM}
+
+
+def check_record(rec: dict, where: str) -> list:
+    errs = []
+    rtype = rec.get("type")
+    if rtype not in SCHEMA:
+        return [f"{where}: unknown record type {rtype!r}"]
+    required, optional, extras = SCHEMA[rtype]
+    required = {**ALWAYS_REQUIRED, **required}
+    for field, types in required.items():
+        if field not in rec:
+            errs.append(f"{where}: {rtype} record missing required {field!r}")
+        elif not isinstance(rec[field], types):
+            errs.append(
+                f"{where}: {rtype}.{field} has type "
+                f"{type(rec[field]).__name__}, want {types}"
+            )
+    for field, value in rec.items():
+        if field == "type" or field in required:
+            continue
+        if field in optional:
+            if not isinstance(value, optional[field]):
+                errs.append(
+                    f"{where}: {rtype}.{field} has type "
+                    f"{type(value).__name__}, want {optional[field]}"
+                )
+        elif extras is None:
+            errs.append(f"{where}: {rtype} record has undeclared field {field!r}")
+        elif extras == "numeric" and not isinstance(value, NUM):
+            errs.append(
+                f"{where}: {rtype} extra field {field!r} must be numeric, "
+                f"got {type(value).__name__}"
+            )
+    return errs
+
+
+def check_file(path: str) -> list:
+    errs = []
+    if path.endswith(".json"):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            return [f"{path}: unreadable ({e})"]
+        rec.setdefault("type", "heartbeat")
+        return check_record(rec, path)
+    with open(path) as f:
+        for n, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if n == sum(1 for _ in open(path)):
+                    continue  # torn trailing line of a killed run is legal
+                errs.append(f"{path}:{n}: unparsable line")
+                continue
+            errs.extend(check_record(rec, f"{path}:{n}"))
+    return errs
+
+
+def main(paths) -> int:
+    errs = []
+    total = 0
+    for path in paths:
+        errs.extend(check_file(path))
+        total += 1
+    for e in errs:
+        print(e)
+    print(
+        f"checked {total} file(s): "
+        + ("OK" if not errs else f"{len(errs)} violation(s)")
+    )
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit("usage: check_telemetry_schema.py <jsonl/json files...>")
+    sys.exit(main(sys.argv[1:]))

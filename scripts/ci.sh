@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# One-shot CI: telemetry-schema lint over the committed evidence logs, then
+# the tier-1 test suite (the exact ROADMAP.md command).  Run from anywhere:
+#
+#   bash scripts/ci.sh
+#
+# Exits non-zero on the first failing stage.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== stage 1/2: telemetry schema lint =="
+python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
+
+echo "== stage 2/2: tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

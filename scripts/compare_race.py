@@ -109,8 +109,22 @@ def main(jax_path, torch_path, noise_path=None, jax_noise_path=None):
             dg = j["gamma"] - t["gamma"]
             ok &= abs(dg) <= TOL_GAMMA
             gcells = f"{j['gamma']:.4f} | {t['gamma']:.4f} | {dg:+.4f}"
+        elif j.get("task_id", 0) > 0:
+            # Alignment runs on every task > 0, so a missing γ here means
+            # one side skipped (or failed to log) a protocol stage — that
+            # fails the γ gate rather than silently rendering a dash.
+            ok = False
+            gj = f"{j['gamma']:.4f}" if j.get("gamma") is not None else "MISSING"
+            gt = f"{t['gamma']:.4f}" if t.get("gamma") is not None else "MISSING"
+            gcells = f"{gj} | {gt} | —"
+            print(
+                f"WARNING: task {j['task_id']} is missing a gamma on "
+                f"{'the jax side' if j.get('gamma') is None else 'the torch side'}"
+                " — alignment did not run or did not log; γ gate FAILED",
+                file=sys.stderr,
+            )
         else:
-            gcells = "— | — | —"
+            gcells = "— | — | —"  # task 0: no alignment by protocol
         print(
             f"| {j['task_id']} | {j['acc1']:.2f} | {t['acc1']:.2f} | "
             f"{d:+.2f} | {gcells} |"
@@ -151,8 +165,7 @@ def main(jax_path, torch_path, noise_path=None, jax_noise_path=None):
         f"**VERDICT: {'PASS' if ok else 'FAIL'}** — "
         + (
             "the integrated trajectories agree within the stated "
-            "tolerances; every component-level parity claim survives "
-            "end-to-end composition."
+            "tolerances; no evidence of algorithmic divergence."
             if ok
             else "at least one metric exceeds its stated tolerance; see "
             "the deltas above."

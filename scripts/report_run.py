@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Render one run's full observability story from its telemetry output.
+
+Usage: python scripts/report_run.py <run.jsonl> [spans.jsonl]
+
+Consumes the unified-sink JSONL a ``--telemetry_dir`` run produces (and the
+span file next to it, auto-discovered when not given):
+
+* config provenance + the per-task accuracy table,
+* the task x task accuracy matrix with per-slice **forgetting** and **BWT**
+  columns (math imported from ``telemetry.cil_metrics`` — the same module
+  the engine logs from, so report and log can never disagree),
+* per-epoch input-stall accounting (host_s vs device_s vs wall),
+* every recompile event, with unexpected ones called out,
+* per-device HBM samples when the backend reports them,
+* span phase coverage: how much of the ``fit`` wall time the depth-1 task
+  spans account for (the acceptance gate is >= 95%), and the phase-level
+  time breakdown under them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.cil_metrics import (  # noqa: E501,E402
+    average_incremental_accuracy,
+    backward_transfer,
+    per_task_forgetting,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.spans import (  # noqa: E402
+    load_spans,
+)
+
+
+def load_records(path: str):
+    by_type = defaultdict(list)
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # truncated trailing line of a killed run
+            by_type[rec.get("type", "?")].append(rec)
+    return by_type
+
+
+def render_tasks(tasks):
+    print("| task | new classes | cum. top-1 (%) | WA γ | seconds |")
+    print("|---|---|---|---|---|")
+    for t in tasks:
+        gamma = f"{t['gamma']:.4f}" if t.get("gamma") is not None else "—"
+        print(
+            f"| {t['task_id']} | {t.get('nb_new', '?')} | {t['acc1']:.2f} | "
+            f"{gamma} | {t.get('seconds', '?')} |"
+        )
+    print()
+
+
+def render_matrix(tasks):
+    rows = {t["task_id"]: t.get("acc_per_task") for t in tasks}
+    if not rows or any(r is None for r in rows.values()):
+        return
+    T = max(rows) + 1
+    complete = sorted(rows) == list(range(T)) and all(
+        len(rows[t]) == t + 1 for t in rows
+    )
+    matrix = [rows[t] for t in sorted(rows)] if complete else None
+    forgetting = per_task_forgetting(matrix) if matrix else None
+    bwt = backward_transfer(matrix) if matrix else None
+    print("accuracy matrix (row = after task t, col = val slice of task j):\n")
+    header = [f"j={j}" for j in range(T)]
+    print("| after task | " + " | ".join(header) + " | forgetting j | BWT |")
+    print("|---|" + "---|" * (T + 2))
+    for tid in sorted(rows):
+        r = rows[tid]
+        cells = [f"{a:.2f}" for a in r] + ["—"] * (T - len(r))
+        # Forgetting/BWT are properties of the *final* row's protocol
+        # prefix; earlier rows carry them blank.
+        fcell = bcell = "—"
+        if tid == T - 1 and forgetting is not None:
+            fcell = ", ".join(f"{f:+.2f}" for f in forgetting)
+            bcell = f"{bwt:+.3f}"
+        print(f"| {tid} | " + " | ".join(cells) + f" | {fcell} | {bcell} |")
+    if not complete:
+        print(
+            "\n(partial matrix — log starts mid-protocol; forgetting/BWT "
+            "need rows for every task)"
+        )
+    print()
+
+
+def render_stalls(epochs):
+    timed = [e for e in epochs if "host_s" in e and "device_s" in e]
+    if not timed:
+        print("(no stall accounting in this log — pre-telemetry run)\n")
+        return
+    print("input-pipeline stall accounting (per epoch):\n")
+    print("| task | epoch | wall s | host s | device s | stall frac |")
+    print("|---|---|---|---|---|---|")
+    for e in timed:
+        print(
+            f"| {e.get('task_id', '?')} | {e.get('epoch', '?')} | "
+            f"{e.get('epoch_s', 0):.2f} | {e['host_s']:.3f} | "
+            f"{e['device_s']:.3f} | {e.get('stall_frac', 0):.3f} |"
+        )
+    worst = max(timed, key=lambda e: e.get("stall_frac", 0))
+    print(
+        f"\nworst stall: task {worst.get('task_id')} epoch "
+        f"{worst.get('epoch')} at {worst.get('stall_frac', 0):.1%} "
+        "host-bound\n"
+    )
+
+
+def render_recompiles(recompiles, warnings_):
+    if not recompiles:
+        print("recompiles: none recorded\n")
+        return
+    total = sum(r.get("new_programs", 0) for r in recompiles)
+    print(
+        f"recompiles: {total} new program(s) across "
+        f"{len(recompiles)} event(s), {len(warnings_)} unexpected\n"
+    )
+    print("| where | group | new | total | expected |")
+    print("|---|---|---|---|---|")
+    for r in recompiles:
+        print(
+            f"| {r.get('where', '?')} | {r.get('group', '—')} | "
+            f"{r.get('new_programs', '?')} | {r.get('total_programs', '?')} | "
+            f"{'yes' if r.get('expected') else '**NO**'} |"
+        )
+    print()
+
+
+def render_hbm(hbm):
+    if not hbm:
+        return
+    print("per-device HBM at task boundaries (peak bytes in use):\n")
+    print("| task | " + " | ".join(sorted(next(iter(hbm))["devices"])) + " |")
+    print("|---|" + "---|" * len(next(iter(hbm))["devices"]))
+    for rec in hbm:
+        cells = [
+            str(
+                rec["devices"][d].get(
+                    "peak_bytes_in_use", rec["devices"][d].get("bytes_in_use", "?")
+                )
+            )
+            for d in sorted(rec["devices"])
+        ]
+        print(f"| {rec.get('task_id', '?')} | " + " | ".join(cells) + " |")
+    print()
+
+
+def render_spans(spans_path: str):
+    spans = load_spans(spans_path)
+    if not spans:
+        print(f"(no spans at {spans_path})\n")
+        return
+    fit = next((s for s in spans if s["name"] == "fit"), None)
+    if fit is None or fit["dur_s"] <= 0:
+        print("(no completed `fit` root span — run killed mid-protocol?)\n")
+        return
+    children = [s for s in spans if s.get("parent") == fit["span_id"]]
+    covered = sum(s["dur_s"] for s in children)
+    frac = covered / fit["dur_s"]
+    gate = "PASS" if frac >= 0.95 else "FAIL"
+    print(
+        f"span coverage: depth-1 spans account for {frac:.1%} of the "
+        f"{fit['dur_s']:.1f}s `fit` wall time — {gate} (gate: >= 95%)\n"
+    )
+    task_ids = {s["span_id"] for s in children}
+    phases = defaultdict(float)
+    for s in spans:
+        if s.get("parent") in task_ids:
+            phases[s["name"]] += s["dur_s"]
+    if phases:
+        print("phase breakdown (summed over tasks):\n")
+        print("| phase | seconds | share of covered |")
+        print("|---|---|---|")
+        for name, dur in sorted(phases.items(), key=lambda kv: -kv[1]):
+            print(f"| {name} | {dur:.2f} | {dur / max(covered, 1e-9):.1%} |")
+        print()
+
+
+def main(run_path: str, spans_path: str | None = None):
+    by_type = load_records(run_path)
+    print(f"# run report — {run_path}\n")
+    if by_type["run"]:
+        cfg = {
+            k: v
+            for k, v in by_type["run"][-1].items()
+            if k not in ("type", "ts")
+        }
+        print(f"config: `{json.dumps(cfg, sort_keys=True)}`\n")
+    tasks = by_type["task"]
+    if tasks:
+        render_tasks(tasks)
+        render_matrix(tasks)
+        acc1s = [t["acc1"] for t in tasks]
+        print(
+            f"avg incremental top-1: "
+            f"{average_incremental_accuracy(acc1s):.3f}% over "
+            f"{len(acc1s)} task(s)\n"
+        )
+    else:
+        print("(no completed tasks in this log)\n")
+    render_stalls(by_type["epoch"])
+    render_recompiles(by_type["recompile"], by_type["recompile_warning"])
+    render_hbm(by_type["hbm"])
+    if spans_path is None:
+        candidate = os.path.join(os.path.dirname(run_path), "spans.jsonl")
+        spans_path = candidate if os.path.exists(candidate) else None
+    if spans_path:
+        render_spans(spans_path)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit("usage: report_run.py <run.jsonl> [spans.jsonl]")
+    main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)

@@ -351,6 +351,10 @@ def main() -> None:
                 )
                 nb_steps += 1
             scheduler.step()  # template.py:278 (per epoch)
+            # The reference's per-epoch cadence eval print (template.py:
+            # 282-283) is omitted as state-neutral: model.eval()/no_grad
+            # touches no parameters, buffers, or RNG draws, so the final
+            # trajectory is unchanged with or without it.
             print(
                 f"train states: epoch :[{epoch + 1}/{args.num_epochs}] "
                 f"ce: {ce_sum / nb_steps:.4f}  kd: {kd_sum / nb_steps:.4f}  "

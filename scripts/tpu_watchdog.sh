@@ -5,6 +5,11 @@
 # a time (a killed client can wedge the chip); every probe runs in a killable
 # subprocess with a timeout so the watchdog itself never hangs.
 #
+# Before probing, the watchdog consults the trainer's heartbeat file
+# (telemetry/heartbeat.py): a fresh beat means a live training process owns
+# the chip — liveness is logged from the beat (step/task/epoch) and the
+# blind probe is skipped entirely.
+#
 # Evidence-preservation: bench/profile output is written to a temp file and
 # only moved into experiments/ on rc=0, so a timed-out or crashed capture
 # never overwrites previously captured evidence with an empty/partial file.
@@ -41,8 +46,41 @@ capture() {  # capture <timeout_s> <dest> <cmd...> — atomic move on success on
 }
 
 INTERVAL=${INTERVAL:-600}
-log "watchdog started (pid $$, interval ${INTERVAL}s)"
+# Liveness file written by a running trainer (telemetry.heartbeat; enable
+# with --telemetry_dir or --heartbeat_path).  While it is fresh the chip is
+# demonstrably busy training — log the trainer's position and DO NOT open a
+# fresh device client to probe (round 5: a probing client can wedge the
+# chip under the very training run we care about).
+HEARTBEAT=${HEARTBEAT:-experiments/heartbeat.json}
+HB_MAX_AGE=${HB_MAX_AGE:-120}
+
+heartbeat_fresh() {  # prints the beat summary and returns 0 when fresh
+  python - "$HEARTBEAT" "$HB_MAX_AGE" <<'PY'
+import sys
+sys.path.insert(0, ".")
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+    read_heartbeat,
+)
+
+beat = read_heartbeat(sys.argv[1], float(sys.argv[2]))
+if beat.get("fresh"):
+    print(
+        f"age={beat['age_s']}s pid={beat.get('pid')} step={beat.get('step')} "
+        f"task={beat.get('task')} epoch={beat.get('epoch')} "
+        f"phase={beat.get('phase')}"
+    )
+    sys.exit(0)
+sys.exit(1)
+PY
+}
+
+log "watchdog started (pid $$, interval ${INTERVAL}s, heartbeat $HEARTBEAT)"
 while true; do
+  if BEAT=$(heartbeat_fresh); then
+    log "trainer heartbeat fresh ($BEAT) — skipping chip probe"
+    sleep "$INTERVAL"
+    continue
+  fi
   if timeout -k 10 90 python -c "
 import jax, numpy as np
 x = jax.numpy.ones((128, 128))

@@ -253,3 +253,21 @@ def test_compare_race_two_by_two_bands(tmp_path):
     assert "2/2 per-task bands overlap" in out
     assert "avg incremental: jax band [95.500, 96.300] vs torch band " \
            "[94.500, 96.050] — overlapping." in out
+
+
+def test_compare_race_missing_gamma_fails_gate(tmp_path, capsys):
+    """Alignment runs on every task > 0: a missing γ there means a protocol
+    stage was skipped or unlogged, which must fail the γ gate instead of
+    rendering a silent dash (task 0's legitimate None stays a dash)."""
+    m = _load_script("compare_race")
+    a, b = str(tmp_path / "jax.jsonl"), str(tmp_path / "torch.jsonl")
+    # Trajectories agree perfectly — only the torch γ at task 1 is missing.
+    _race_log(a, [99.0, 95.0], [None, 0.96], 97.0, [[99.0], [93.0, 97.0]])
+    _race_log(b, [99.0, 95.0], [None, None], 97.0, [[99.0], [93.0, 97.0]])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.main(a, b)
+    out = buf.getvalue()
+    assert "**VERDICT: FAIL**" in out
+    assert "MISSING" in out
+    assert "missing a gamma" in capsys.readouterr().err

@@ -1,0 +1,399 @@
+"""Telemetry subsystem: spans, heartbeat, stall clock, recompile monitor,
+CIL metrics, the Telemetry facade, and the schema lint.  All CPU-only and
+trainer-free — the only jitted code is a scalar add (the recompile probe)."""
+
+import importlib.util
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+    AccuracyMatrix,
+    Heartbeat,
+    RecompileMonitor,
+    SpanTracer,
+    StallClock,
+    Telemetry,
+    backward_transfer,
+    clocked,
+    coverage,
+    load_spans,
+    per_task_forgetting,
+    read_heartbeat,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (
+    JsonlLogger,
+    NullSink,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------- #
+# Spans
+# --------------------------------------------------------------------------- #
+
+
+def test_span_nesting_and_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tr = SpanTracer(path, process_index=0)
+    with tr.span("fit"):
+        with tr.span("task", task=0):
+            with tr.span("epoch", task=0, epoch=1):
+                time.sleep(0.01)
+        with tr.span("task", task=1):
+            pass
+    spans = load_spans(path)
+    assert [s["name"] for s in spans] == ["epoch", "task", "task", "fit"]
+    by_name = {
+        (s["name"], s.get("task")): s for s in spans
+    }
+    fit = by_name[("fit", None)]
+    t0, t1 = by_name[("task", 0)], by_name[("task", 1)]
+    ep = by_name[("epoch", 0)]
+    # Exit-order write, tree-structure intact.
+    assert fit["depth"] == 0 and fit["parent"] is None
+    assert t0["parent"] == fit["span_id"] and t0["depth"] == 1
+    assert ep["parent"] == t0["span_id"] and ep["depth"] == 2
+    assert t1["parent"] == fit["span_id"]
+    # Attrs ride along; durations nest (parent >= child).
+    assert ep["epoch"] == 1
+    assert t0["dur_s"] >= ep["dur_s"] >= 0.01
+    assert fit["dur_s"] >= t0["dur_s"] + t1["dur_s"]
+
+
+def test_span_coverage_and_chrome_export(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tr = SpanTracer(path, process_index=0)
+    with tr.span("fit"):
+        with tr.span("task", task=0):
+            time.sleep(0.02)
+        time.sleep(0.002)  # deliberate un-attributed gap
+    cov = tr.coverage(depth=1)
+    assert cov is not None and 0.5 < cov < 1.0
+    # The module-level function agrees on re-loaded records.
+    assert coverage(load_spans(path), depth=1) == pytest.approx(cov)
+    chrome = str(tmp_path / "trace.json")
+    tr.export_chrome_trace(chrome)
+    with open(chrome) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert {e["name"] for e in events} == {"fit", "task"}
+    fit_ev = next(e for e in events if e["name"] == "fit")
+    task_ev = next(e for e in events if e["name"] == "task")
+    assert fit_ev["ph"] == "X" and fit_ev["dur"] >= task_ev["dur"]
+    assert task_ev["args"]["task"] == 0
+
+
+def test_span_tracer_disabled_is_noop(tmp_path):
+    tr = SpanTracer(None)
+    with tr.span("fit"):
+        pass
+    assert tr.completed == [] and not tr.enabled
+    # Non-zero process index: silenced even with a path.
+    tr2 = SpanTracer(str(tmp_path / "s.jsonl"), process_index=1)
+    with tr2.span("fit"):
+        pass
+    assert not tr2.enabled and not os.path.exists(tmp_path / "s.jsonl")
+
+
+# --------------------------------------------------------------------------- #
+# Heartbeat
+# --------------------------------------------------------------------------- #
+
+
+def test_heartbeat_atomic_and_monotonic(tmp_path):
+    path = str(tmp_path / "hb.json")
+    hb = Heartbeat(path, interval_s=100.0, process_index=0)
+    seqs = []
+    for step in range(1, 6):
+        hb.update(force=True, step=step, task=0)
+        with open(path) as f:
+            beat = json.load(f)  # always parsable: atomic replace
+        assert beat["type"] == "heartbeat"
+        assert beat["step"] == step
+        seqs.append(beat["seq"])
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # No temp files left behind.
+    assert os.listdir(tmp_path) == ["hb.json"]
+    # None-valued fields do not erase previously reported state.
+    hb.update(force=True, epoch=3, step=None)
+    with open(path) as f:
+        beat = json.load(f)
+    assert beat["step"] == 5 and beat["epoch"] == 3
+
+
+def test_heartbeat_thread_beats_and_freshness(tmp_path):
+    path = str(tmp_path / "hb.json")
+    hb = Heartbeat(path, interval_s=0.1, process_index=0)
+    hb.start()
+    try:
+        time.sleep(0.35)  # several thread cadences, no update() calls
+        beat = read_heartbeat(path, max_age_s=0.2)
+        assert beat["fresh"], beat
+        assert beat["seq"] > 1  # the thread beat on its own
+    finally:
+        hb.stop()
+    assert hb._thread is None
+    stale = read_heartbeat(path, max_age_s=-1.0)
+    assert not stale["fresh"]
+    assert not read_heartbeat(str(tmp_path / "missing.json"), 60.0)["fresh"]
+
+
+def test_heartbeat_disabled_noop(tmp_path):
+    hb = Heartbeat(None)
+    hb.update(force=True, step=1)
+    hb.start()
+    hb.stop()
+    # Non-zero process: no file even with a path.
+    hb2 = Heartbeat(str(tmp_path / "hb.json"), process_index=3)
+    hb2.update(force=True, step=1)
+    assert not os.path.exists(tmp_path / "hb.json")
+
+
+# --------------------------------------------------------------------------- #
+# Stall clock
+# --------------------------------------------------------------------------- #
+
+
+def test_stall_clock_sums_to_wall_time():
+    clock = StallClock()
+    t0 = time.perf_counter()
+    with clock.host():
+        time.sleep(0.03)
+    with clock.device():
+        time.sleep(0.05)
+    wall = time.perf_counter() - t0
+    assert clock.host_s >= 0.03 and clock.device_s >= 0.05
+    # The two buckets account for the wall time within loop-bookkeeping
+    # tolerance (generous bound: scheduler jitter on a loaded CI box).
+    assert clock.host_s + clock.device_s == pytest.approx(wall, rel=0.25)
+    assert 0.0 < clock.stall_frac < 1.0
+    snap = clock.snapshot()
+    assert set(snap) == {"host_s", "device_s", "stall_frac"}
+
+
+def test_clocked_charges_batch_production_to_host():
+    clock = StallClock()
+
+    def slow_batches():
+        for i in range(3):
+            time.sleep(0.01)  # inside next(): production cost
+            yield i
+
+    assert list(clocked(slow_batches(), clock)) == [0, 1, 2]
+    assert clock.host_s >= 0.03 and clock.device_s == 0.0
+
+
+# --------------------------------------------------------------------------- #
+# Recompile monitor
+# --------------------------------------------------------------------------- #
+
+
+def test_recompile_monitor_flags_forced_rejit(tmp_path):
+    sink = JsonlLogger(str(tmp_path / "log.jsonl"))
+    mon = RecompileMonitor(sink)
+    f = jax.jit(lambda x: x + 1)
+    mon.track("f", f, group="train")
+    f(jnp.zeros((2,)))
+    assert mon.check("task0/epoch1", expected=True, group="train") == 1
+    # Steady state: same shape, no growth, no records.
+    f(jnp.ones((2,)))
+    assert mon.check("task0/epoch2", expected=False, group="train") == 0
+    # Forced re-jit via a new shape at a not-expected point: warns.
+    f(jnp.zeros((3,)))
+    with pytest.warns(RuntimeWarning, match="unexpected XLA recompile"):
+        assert mon.check("task0/epoch3", expected=False, group="train") == 1
+    records = [json.loads(l) for l in open(tmp_path / "log.jsonl")]
+    kinds = [r["type"] for r in records]
+    assert kinds == ["recompile", "recompile", "recompile_warning"]
+    assert records[0]["expected"] is True
+    assert records[-1]["where"] == "task0/epoch3"
+    assert all(r["group"] == "train" for r in records)
+
+
+def test_recompile_monitor_groups_are_independent():
+    mon = RecompileMonitor(NullSink())
+    f = jax.jit(lambda x: x * 2)
+    g = jax.jit(lambda x: x * 3)
+    mon.track("f", f, group="train")
+    mon.track("g", g, group="eval")
+    f(jnp.zeros((2,)))
+    g(jnp.zeros((2,)))
+    # An expected eval compile must not mask (or be masked by) train state.
+    assert mon.check("e", expected=True, group="eval") == 1
+    assert mon.check("t", expected=True, group="train") == 1
+    assert mon.total() == 2 and mon.total("eval") == 1
+
+
+def test_recompile_monitor_ignores_untracked_objects():
+    mon = RecompileMonitor(NullSink())
+    mon.track("plain", lambda x: x)  # no _cache_size: silently skipped
+    assert mon.total() == 0
+    assert mon.check("anywhere", expected=False) == 0
+
+
+# --------------------------------------------------------------------------- #
+# CIL metrics
+# --------------------------------------------------------------------------- #
+
+HAND_MATRIX = [[90.0], [60.0, 80.0], [50.0, 65.0, 65.0]]
+
+
+def test_forgetting_and_bwt_hand_computed():
+    # f_j maxes over rows t in [j, T-2]: f_0 = max(90, 60) - 50 = 40;
+    # f_1 = 80 - 65 = 15 (row 1 is the only pre-final row seeing slice 1).
+    assert per_task_forgetting(HAND_MATRIX) == [40.0, 15.0]
+    # BWT = mean(50-90, 65-80) = mean(-40, -15) = -27.5.
+    assert backward_transfer(HAND_MATRIX) == -27.5
+    assert per_task_forgetting([[90.0]]) is None
+    assert backward_transfer([[90.0]]) is None
+
+
+def test_accuracy_matrix_summary_and_partial():
+    m = AccuracyMatrix()
+    for t, row in enumerate(HAND_MATRIX):
+        m.add_row(t, row)
+    assert m.complete and m.as_list() == HAND_MATRIX
+    s = m.summary()
+    assert s == {"nb_tasks": 3, "forgetting": [40.0, 15.0], "bwt": -27.5}
+    # Mid-protocol resume without earlier rows: partial, never wrong numbers.
+    p = AccuracyMatrix()
+    p.add_row(2, [50.0, 65.0, 65.0])
+    assert not p.complete
+    assert p.summary() == {"partial": True, "tasks": [2]}
+    with pytest.raises(ValueError):
+        p.add_row(1, [1.0])  # wrong row length
+
+
+# --------------------------------------------------------------------------- #
+# Facade + schema lint
+# --------------------------------------------------------------------------- #
+
+
+def test_telemetry_facade_end_to_end(tmp_path):
+    tdir = str(tmp_path / "tel")
+    sink = JsonlLogger(str(tmp_path / "run.jsonl"))
+    tel = Telemetry(telemetry_dir=tdir, heartbeat_interval_s=100.0, sink=sink)
+    assert tel.enabled
+    with tel.span("fit"):
+        with tel.span("task", task=0):
+            pass
+        tel.heartbeat.update(force=True, step=1, task=0)
+    tel.close()
+    assert load_spans(os.path.join(tdir, "spans.jsonl"))
+    assert json.load(open(os.path.join(tdir, "trace.json")))["traceEvents"]
+    assert read_heartbeat(os.path.join(tdir, "heartbeat.json"), 60.0)["fresh"]
+
+
+def test_telemetry_facade_disabled_noop(tmp_path):
+    tel = Telemetry()  # no dir, no heartbeat, Null sink
+    assert not tel.enabled
+    with tel.span("fit"):
+        pass
+    tel.log_hbm(task_id=0)
+    tel.close()
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_schema_lint_accepts_engine_vocabulary(tmp_path):
+    m = _load_script("check_telemetry_schema")
+    path = str(tmp_path / "run.jsonl")
+    sink = JsonlLogger(path)
+    sink.log("run", data_set="synthetic10", backbone="resnet20", seed=0)
+    sink.log("epoch", task_id=0, epoch=1, lr=0.1, epoch_s=2.0, host_s=0.5,
+             device_s=1.4, stall_frac=0.26, loss=1.0)
+    sink.log("task", task_id=0, acc1=90.0, acc1s=[90.0], nb_new=5,
+             known_after=5, seconds=3.0, gamma=None, acc_per_task=[90.0])
+    sink.log("cil_metrics", task_id=0, avg_incremental_acc1=90.0,
+             partial=True, tasks=[0])
+    sink.log("recompile", where="task0/epoch1", new_programs=1,
+             total_programs=1, expected=True, group="train")
+    sink.log("final", acc1s=[90.0], avg_incremental_acc1=90.0, nb_tasks=1,
+             forgetting=None, bwt=None)
+    assert m.check_file(path) == []
+
+
+def test_schema_lint_rejects_drift(tmp_path):
+    m = _load_script("check_telemetry_schema")
+    assert m.check_record({"type": "wormhole", "ts": 1.0}, "x") != []
+    # Missing required field.
+    assert any(
+        "missing required" in e
+        for e in m.check_record({"type": "resume", "ts": 1.0}, "x")
+    )
+    # Undeclared field on a closed record type.
+    assert any(
+        "undeclared" in e
+        for e in m.check_record(
+            {"type": "resume", "ts": 1.0, "start_task": 1, "oops": 2}, "x"
+        )
+    )
+    # Epoch extras must be numeric meters.
+    assert any(
+        "must be numeric" in e
+        for e in m.check_record(
+            {"type": "epoch", "ts": 1.0, "task_id": 0, "epoch": 1, "lr": 0.1,
+             "note": "hi"},
+            "x",
+        )
+    )
+    # Heartbeat (.json single-record path) validates too.
+    hb = tmp_path / "heartbeat.json"
+    hb.write_text(json.dumps({"ts": 1.0, "seq": 1, "pid": 7, "step": 3}))
+    assert m.check_file(str(hb)) == []
+
+
+# --------------------------------------------------------------------------- #
+# Committed state scalars (the recompile leak the monitor actually caught)
+# --------------------------------------------------------------------------- #
+
+
+def test_replicated_scalar_keeps_jit_cache_stable(devices8):
+    """A bare jnp.int32 state leaf next to mesh-committed params recompiles
+    the carrying program on its second call: the program's output scalar
+    comes back committed to the mesh, a different cache key from the
+    uncommitted fresh input.  replicated_scalar commits at creation, so the
+    second call hits the cache.  Regression for the task*/epoch2 recompile
+    the monitor flagged on first integration."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import (
+        make_mesh,
+        replicated_scalar,
+    )
+
+    mesh = make_mesh((8, 1))
+    # Stand-in for params: committed to the mesh like shard_params output.
+    xs = jax.device_put(jnp.zeros(8), NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def carry(state):
+        x, n = state
+        return x + 1.0, n + 0
+
+    # Bare scalar: second call sees the committed output -> cache grows.
+    state = (xs, jnp.int32(0))
+    state = carry(state)
+    state = carry(state)
+    assert carry._cache_size() == 2
+
+    carry.clear_cache()
+    s = replicated_scalar(mesh, 0)
+    assert s.committed and s.dtype == jnp.int32
+    state = (xs, s)
+    state = carry(state)
+    state = carry(state)
+    assert carry._cache_size() == 1
