@@ -137,6 +137,11 @@ class CilConfig:
     fused_epochs: bool = True  # run each epoch as ONE lax.scan program with
     # the task dataset resident on device (in-memory datasets only; lazy
     # path-based datasets fall back to the per-batch host loop)
+    prefetch_depth: int = 0  # input-pipeline ring-buffer depth for the
+    # per-batch paths (step loop, eval, herding): N > 0 runs host batch
+    # production + device_put on a background thread so H2D transfer of
+    # batch k+1 overlaps device compute of batch k (data/prefetch.py);
+    # 0 = synchronous.  Batch streams are byte-identical at every depth.
 
     # Checkpointing
     ckpt_dir: Optional[str] = None
@@ -276,6 +281,12 @@ def get_args_parser() -> argparse.ArgumentParser:
                    dest="fused_epochs", default=True,
                    help="dispatch one device program per batch instead of "
                    "one lax.scan program per epoch")
+    p.add_argument("--prefetch_depth", default=d.prefetch_depth, type=int,
+                   help="input-pipeline ring-buffer depth for the per-batch "
+                   "paths: N>0 produces batches and issues device_put on a "
+                   "background thread, overlapping H2D transfer with device "
+                   "compute; 0 = synchronous (identical batch stream either "
+                   "way)")
     p.add_argument("--platform", default="default",
                    choices=["default", "cpu", "tpu"],
                    help="JAX platform to force before backend init "
@@ -334,6 +345,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         bn_group_size=args.bn_group_size,
         use_pallas_loss=args.use_pallas_loss,
         fused_epochs=args.fused_epochs,
+        prefetch_depth=args.prefetch_depth,
         ckpt_dir=args.ckpt_dir,
         ckpt_backend=args.ckpt_backend,
         resume=args.resume,

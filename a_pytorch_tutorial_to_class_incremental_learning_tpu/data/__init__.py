@@ -21,6 +21,7 @@ from .memory import (  # noqa: F401
     herd_random,
 )
 from .loader import eval_batches, sequential_batches, train_batches  # noqa: F401
+from .prefetch import DevicePrefetcher  # noqa: F401
 
 
 def build_scenario(config, train: bool):

@@ -18,6 +18,10 @@ Design points:
   process-major mesh; per-epoch reshuffling is seeded like
   ``sampler.set_epoch`` (reference ``template.py:253``) but from the threaded
   PRNG key.
+* **Synchronous by design.** These generators are pure and deterministic;
+  overlap with device compute is layered on top by ``data/prefetch.py``,
+  which iterates them unchanged from a background thread
+  (``--prefetch_depth``), so the batch stream is identical either way.
 """
 
 from __future__ import annotations

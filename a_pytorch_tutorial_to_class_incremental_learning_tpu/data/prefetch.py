@@ -1,0 +1,222 @@
+"""Asynchronous double-buffered input pipeline (ring-buffer prefetcher).
+
+The reference hides input latency behind PyTorch's DataLoader worker pool
+(SURVEY.md #24); our loaders are synchronous generators, so on the per-step
+path every batch's index gather, host decode and ``device_put`` happened
+while the device sat idle — exactly the host/device stall split the
+:class:`~..telemetry.StallClock` makes visible.  This module eliminates it
+the way pjit/TPU training stacks do (arXiv:2204.06514) and Podracer-style
+producer/consumer architectures do (arXiv:2104.06272): a background thread
+runs batch *production* (permutation slice, uint8 row gather, decode) and
+issues the ``device_put`` toward the target ``NamedSharding`` ahead of
+consumption, so the H2D DMA for batch *k+1* overlaps the device compute of
+batch *k*.
+
+Guarantees:
+
+* **Byte-identical streams.**  The producer thread iterates the very same
+  synchronous generator the caller would have iterated (same seeds, same
+  order); threading changes *when* a batch is produced, never *what*.
+* **Exception propagation.**  An exception anywhere in production (source
+  generator or placement) is caught on the producer thread, enqueued, and
+  re-raised in the consumer — after the thread has been shut down cleanly.
+* **Clean shutdown.**  ``close()`` (idempotent; also invoked on exhaustion,
+  on error, and by the context-manager exit) signals the producer, drains
+  the ring buffer, and joins the thread — no leaked threads on early loop
+  exit, and no retained device buffers.
+* **Donation safety.**  A batch handed to the consumer is *popped* from the
+  ring buffer and the prefetcher drops every reference to it before the
+  consumer sees it, so a buffer passed on to a donating jitted step is never
+  also reachable through the prefetcher.
+
+Telemetry contract: with a ``clock`` (duck-typed ``StallClock``) attached,
+only the *residual* — the time the consumer actually blocks waiting for the
+ring buffer — is charged to the host bucket; fully-overlapped production
+costs nothing.  At ``depth <= 0`` the prefetcher degrades to a synchronous
+passthrough (no thread, no queue) and the full production cost is charged,
+reproducing the pre-prefetch accounting exactly.  Ring-buffer fill is
+sampled at every ``get`` and reported by :meth:`DevicePrefetcher.stats` as
+``prefetch_depth_occupancy`` (1.0 = producer always ahead, the run is
+compute-bound; ~0 = consumer always waiting, the run is data-bound).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterable, Iterator, Optional
+
+_BATCH, _DONE, _ERROR = "batch", "done", "error"
+
+
+class DevicePrefetcher:
+    """Depth-N ring-buffer prefetcher over ``(source, place)``.
+
+    ``source`` is any synchronous host-batch iterable; ``place`` maps one
+    host batch to its device-resident form (decode + ``device_put`` with the
+    target sharding) and runs on the producer thread when ``depth > 0``,
+    inline otherwise.  Iterate the prefetcher exactly like the source; use
+    it as a context manager (or ``contextlib.closing``) so early exits shut
+    the producer down deterministically.
+    """
+
+    def __init__(
+        self,
+        source: Iterable,
+        place: Optional[Callable] = None,
+        depth: int = 0,
+        clock=None,
+        name: str = "prefetch",
+    ):
+        self._source = iter(source)
+        self._place = place if place is not None else (lambda batch: batch)
+        self.depth = max(0, int(depth))
+        self._clock = clock
+        self._fill_sum = 0
+        self._gets = 0
+        self._closed = False
+        self._exhausted = False
+        self._stop = threading.Event()
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        if self.depth > 0:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._produce, name=name, daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # Producer thread
+    # ------------------------------------------------------------------ #
+
+    def _produce(self) -> None:
+        try:
+            for host_batch in self._source:
+                placed = (_BATCH, self._place(host_batch))
+                del host_batch
+                if not self._enqueue(placed):
+                    return  # close() raced us; drop the reference and exit
+                del placed  # donation safety: no trailing reference
+            self._enqueue((_DONE, None))
+        except BaseException as e:  # noqa: BLE001 — must cross the thread
+            self._enqueue((_ERROR, e))
+
+    def _enqueue(self, item) -> bool:
+        """Bounded put that stays responsive to ``close()``."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ------------------------------------------------------------------ #
+    # Consumer side
+    # ------------------------------------------------------------------ #
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._closed:
+            raise StopIteration
+        if self.depth == 0:
+            # Synchronous passthrough: production (source + placement) runs
+            # inline and its full cost is host/input-pipeline time.
+            t0 = time.perf_counter()
+            try:
+                try:
+                    host_batch = next(self._source)
+                except StopIteration:
+                    self._exhausted = True
+                    self.close()
+                    raise
+                return self._place(host_batch)
+            finally:
+                if self._clock is not None:
+                    self._clock.add_host(time.perf_counter() - t0)
+        # Ring-buffer fill right before the blocking get: the occupancy
+        # sample ("was a batch ready when the consumer came back?").
+        self._fill_sum += self._queue.qsize()
+        self._gets += 1
+        t0 = time.perf_counter()
+        tag, payload = self._queue.get()
+        # Only the non-overlapped residual is input-pipeline stall.
+        if self._clock is not None:
+            self._clock.add_host(time.perf_counter() - t0)
+        if tag == _BATCH:
+            return payload
+        self._exhausted = True
+        self.close()
+        if tag == _ERROR:
+            raise payload
+        raise StopIteration
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    def close(self) -> None:
+        """Stop the producer, drop buffered batches, join the thread.
+
+        Idempotent; safe at any point (mid-stream early exit included).
+        Draining the queue both unblocks a producer stuck in ``put`` and
+        releases every prefetched device buffer the consumer never took.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._drain()  # unblock a producer stuck in put
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            if self._thread.is_alive():  # pragma: no cover — defensive
+                raise RuntimeError(
+                    "prefetch producer thread failed to shut down"
+                )
+            self._thread = None
+        # Drain again AFTER the join: the producer may have completed one
+        # final put between the first drain and its check of the stop flag.
+        self._drain()
+        if self._clock is not None and hasattr(self._clock, "set_prefetch"):
+            self._clock.set_prefetch(self.depth, self.occupancy())
+
+    def _drain(self) -> None:
+        if self._queue is None:
+            return
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                return
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # pragma: no cover — belt and braces
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+
+    def occupancy(self) -> float:
+        """Mean ring-buffer fill fraction sampled at each consumer get."""
+        if self.depth <= 0 or self._gets == 0:
+            return 0.0
+        return self._fill_sum / (self._gets * self.depth)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "prefetch_depth": self.depth,
+            "prefetch_depth_occupancy": round(self.occupancy(), 4),
+        }

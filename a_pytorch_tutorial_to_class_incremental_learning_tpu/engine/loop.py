@@ -32,6 +32,7 @@ from flax.core import unfreeze
 
 from ..config import CilConfig
 from ..data import (
+    DevicePrefetcher,
     RehearsalMemory,
     build_scenario,
     eval_batches,
@@ -524,7 +525,7 @@ class CilTrainer:
             clock = StallClock()
             with self.telemetry.span(
                 "epoch", task=task_id, epoch=epoch + 1
-            ), task_trace(profile_here, f"task{task_id}_epoch0"):
+            ), task_trace(profile_here, f"task{task_id}_epoch0") as trace_path:
                 if fused:
                     pending = self._run_epoch_fused(
                         data_x, data_y, epoch_key, lr, lam, clock
@@ -534,7 +535,19 @@ class CilTrainer:
                         task_id, task_train, epoch, epoch_key, lr, lam, clock
                     )
                 if profile_here:
+                    # Fence inside the trace window so the device events of
+                    # the last dispatched steps land in the capture.
                     jax.block_until_ready(self.state.params)
+            if trace_path:
+                # The capture's location is evidence; a trace nobody can
+                # find is a trace that never happened.
+                print(f"profiler trace captured under {trace_path}")
+                self.jsonl.log(
+                    "profile_trace",
+                    task_id=task_id,
+                    name=f"task{task_id}_epoch0",
+                    path=trace_path,
+                )
             logger = MetricLogger(delimiter="  ")
             for m in pending:  # floatify once per epoch: no per-step sync
                 logger.update(**m)
@@ -586,7 +599,15 @@ class CilTrainer:
         lam: float,
         clock: Optional[StallClock] = None,
     ) -> List[Dict]:
-        """One device dispatch per batch (lazy datasets / debugging)."""
+        """One device dispatch per batch (lazy datasets / debugging).
+
+        With ``cfg.prefetch_depth > 0`` batch production — permutation
+        slice, uint8 gather, host decode, key derivation and the sharded
+        ``device_put`` — runs on the prefetcher's background thread, so the
+        H2D transfer of batch *k+1* overlaps the device compute of batch
+        *k*; ``clock`` then accumulates only the residual (non-overlapped)
+        host time.  The batch stream is byte-identical at every depth.
+        """
         cfg = self.config
         clock = clock if clock is not None else StallClock()
         step_fn = self._steps[self.teacher is not None]
@@ -595,37 +616,46 @@ class CilTrainer:
         # Same shuffle on every process (sampler.set_epoch equivalent,
         # reference template.py:253).
         shuffle_seed = hash((cfg.seed, task_id, epoch)) & 0x7FFFFFFF
-        pending: List[Dict] = []
-        for step_idx, (xb, yb) in enumerate(
+
+        def _placed(item):
+            step_idx, (xb, yb) = item
+            xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
+            # Same key on every process (replicated jit operands must be
+            # process-consistent); per-image randomness comes from the
+            # split over the global batch inside train_augment.
+            key = jax.random.fold_in(epoch_key, step_idx)
+            x, y = self._put(xb, yb)
+            return x, y, key
+
+        source = enumerate(
             train_batches(
-                task_train,
-                self.global_batch_size,
-                shuffle_seed,
-                pidx,
-                pcount,
-                clock=clock,
+                task_train, self.global_batch_size, shuffle_seed, pidx, pcount
             )
-        ):
-            t_step = time.perf_counter()
-            with clock.host():  # decode + device_put are input-pipeline work
-                xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
-                # Same key on every process (replicated jit operands must be
-                # process-consistent); per-image randomness comes from the
-                # split over the global batch inside train_augment.
-                key = jax.random.fold_in(epoch_key, step_idx)
-                x, y = self._put(xb, yb)
-            with clock.device():
-                self.state, metrics = step_fn(
-                    self.state, self.teacher, x, y, key, lr, lam
+        )
+        pending: List[Dict] = []
+        with DevicePrefetcher(
+            source,
+            _placed,
+            cfg.prefetch_depth,
+            clock=clock,
+            name=f"prefetch-train-t{task_id}",
+        ) as batches:
+            for x, y, key in batches:
+                t_step = time.perf_counter()
+                with clock.device():
+                    self.state, metrics = step_fn(
+                        self.state, self.teacher, x, y, key, lr, lam
+                    )
+                pending.append(metrics)
+                self._global_step += 1
+                hb.update(
+                    step=self._global_step,
+                    task=task_id,
+                    epoch=epoch + 1,
+                    last_step_ms=round(
+                        (time.perf_counter() - t_step) * 1e3, 2
+                    ),
                 )
-            pending.append(metrics)
-            self._global_step += 1
-            hb.update(
-                step=self._global_step,
-                task=task_id,
-                epoch=epoch + 1,
-                last_step_ms=round((time.perf_counter() - t_step) * 1e3, 2),
-            )
         # ONE device->host transfer for the whole epoch's metrics: per-scalar
         # fetches cost a full RPC round trip each on tunneled TPU platforms
         # (~90 ms measured), which would dwarf the steps themselves.
@@ -681,25 +711,34 @@ class CilTrainer:
         batches carry zero weight, so totals over disjoint slices sum
         exactly to the totals over their union."""
         pidx, pcount = jax.process_index(), jax.process_count()
-        totals = None
-        for xb, yb, wb in eval_batches(
-            dataset_val, self.global_batch_size, pidx, pcount
-        ):
+
+        def _placed(batch):
+            xb, yb, wb = batch
             xb = self._decode(xb, train=False, seed=0)
-            x, y, w = self._put(xb, yb, wb)
-            out = self.eval_step(
-                self.state.params,
-                self.state.batch_stats,
-                x,
-                y,
-                w,
-                self.state.num_active,
-            )
-            # Accumulate ON DEVICE; batches dispatch back-to-back and the
-            # whole eval costs exactly one device->host fetch at the end
-            # (per-scalar fetches are ~90 ms RPCs on tunneled platforms).
-            s = jnp.stack(out)
-            totals = s if totals is None else totals + s
+            return self._put(xb, yb, wb)
+
+        totals = None
+        with DevicePrefetcher(
+            eval_batches(dataset_val, self.global_batch_size, pidx, pcount),
+            _placed,
+            self.config.prefetch_depth,
+            name="prefetch-eval",
+        ) as batches:
+            for x, y, w in batches:
+                out = self.eval_step(
+                    self.state.params,
+                    self.state.batch_stats,
+                    x,
+                    y,
+                    w,
+                    self.state.num_active,
+                )
+                # Accumulate ON DEVICE; batches dispatch back-to-back and
+                # the whole eval costs exactly one device->host fetch at the
+                # end (per-scalar fetches are ~90 ms RPCs on tunneled
+                # platforms).
+                s = jnp.stack(out)
+                totals = s if totals is None else totals + s
         # First eval after a head growth legitimately compiles the new
         # classifier shape; any other eval-program growth warns.
         self.telemetry.recompiles.check(
@@ -728,18 +767,24 @@ class CilTrainer:
         # template.py:292-293).
         rep = replicated(self.mesh)
         feat_key = jax.random.fold_in(self.root_key, 0xFEED + task_id)
-        for i, (xb, _yb) in enumerate(
-            sequential_batches(task_train, self.global_batch_size)
-        ):
+
+        def _placed(item):
+            i, (xb, _yb) = item
             xb = self._decode(xb, train=cfg.herding_augmented, seed=i)
             x = self._put(xb, sharding=rep)
-            f = self.feature_step(
-                self.state.params,
-                self.state.batch_stats,
-                x,
-                jax.random.fold_in(feat_key, i),
-            )
-            feats.append(f)  # stays on device; one concat + one fetch below
+            return x, jax.random.fold_in(feat_key, i)
+
+        with DevicePrefetcher(
+            enumerate(sequential_batches(task_train, self.global_batch_size)),
+            _placed,
+            cfg.prefetch_depth,
+            name="prefetch-herd",
+        ) as batches:
+            for x, key in batches:
+                f = self.feature_step(
+                    self.state.params, self.state.batch_stats, x, key
+                )
+                feats.append(f)  # on device; one concat + one fetch below
         features = np.asarray(jnp.concatenate(feats))[: len(task_train)]
         # The herding pass's first run after a head growth compiles the new
         # shape; growth at any later herd warns.

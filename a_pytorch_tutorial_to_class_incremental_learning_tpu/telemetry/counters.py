@@ -41,6 +41,11 @@ class StallClock:
     def __init__(self):
         self.host_s = 0.0
         self.device_s = 0.0
+        # Filled in by a DevicePrefetcher at shutdown: ring depth and mean
+        # fill fraction.  None until a prefetching iterator reports in, so
+        # non-prefetch epochs carry no invented zeros.
+        self.prefetch_depth: Optional[int] = None
+        self.prefetch_occupancy: Optional[float] = None
 
     @contextmanager
     def host(self) -> Iterator[None]:
@@ -61,17 +66,35 @@ class StallClock:
     def add_host(self, dt: float) -> None:
         self.host_s += dt
 
+    def set_prefetch(self, depth: int, occupancy: float) -> None:
+        """Record the input prefetcher's ring state for this epoch.
+
+        With prefetching on, ``host_s`` holds only the *residual* (non-
+        overlapped) production time — the occupancy says why: ~1.0 means the
+        producer stayed ahead (compute-bound), ~0 means the consumer kept
+        draining the ring dry (data-bound).
+        """
+        if depth > 0:
+            self.prefetch_depth = int(depth)
+            self.prefetch_occupancy = float(occupancy)
+
     @property
     def stall_frac(self) -> float:
         total = self.host_s + self.device_s
         return self.host_s / total if total > 0 else 0.0
 
     def snapshot(self) -> Dict[str, float]:
-        return {
+        snap = {
             "host_s": round(self.host_s, 4),
             "device_s": round(self.device_s, 4),
             "stall_frac": round(self.stall_frac, 4),
         }
+        if self.prefetch_depth is not None:
+            snap["prefetch_depth"] = self.prefetch_depth
+            snap["prefetch_depth_occupancy"] = round(
+                self.prefetch_occupancy or 0.0, 4
+            )
+        return snap
 
 
 def clocked(batches: Iterable, clock: StallClock) -> Iterator:

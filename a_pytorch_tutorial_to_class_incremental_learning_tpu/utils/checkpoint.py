@@ -178,11 +178,27 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
         ckptr = ocp.StandardCheckpointer()
         restored = ckptr.restore(os.path.abspath(path), template)
         ckptr.close()
-        params = restored["params"]
-        batch_stats = restored["batch_stats"]
+        # Same re-homing copy as the pickle branch below: restored arrays can
+        # alias checkpoint-reader host buffers, which the donating train
+        # programs must never be handed.
+        params = jax.tree_util.tree_map(jnp.copy, restored["params"])
+        batch_stats = jax.tree_util.tree_map(jnp.copy, restored["batch_stats"])
     else:
-        params = shard_params(trainer.mesh, payload["params"])
-        batch_stats = shard_params(trainer.mesh, payload["batch_stats"])
+        # jnp.copy after placement is load-bearing: on CPU, device_put of an
+        # aligned host array is zero-copy, so the jax.Array would alias the
+        # unpickled numpy buffer.  The fused epoch / train step *donate* the
+        # TrainState, and XLA freeing a donated buffer it doesn't own
+        # corrupts the heap (observed: NaN metrics on the resumed task, then
+        # SIGBUS/abort in the epoch after restore).  The copy re-homes every
+        # leaf into an XLA-owned buffer with the same sharding — the same
+        # rule the teacher snapshot follows (engine/loop.py "Copied, not
+        # aliased").
+        params = jax.tree_util.tree_map(
+            jnp.copy, shard_params(trainer.mesh, payload["params"])
+        )
+        batch_stats = jax.tree_util.tree_map(
+            jnp.copy, shard_params(trainer.mesh, payload["batch_stats"])
+        )
     known = int(payload["known"])
     trainer.state = trainer.state.replace(
         params=params,

@@ -14,13 +14,24 @@ import jax
 
 
 @contextlib.contextmanager
-def task_trace(profile_dir: Optional[str], name: str) -> Iterator[None]:
+def task_trace(profile_dir: Optional[str], name: str) -> Iterator[Optional[str]]:
+    """Capture a ``jax.profiler`` trace of the wrapped region.
+
+    Yields the capture directory (``None`` when profiling is off) so the
+    caller can record the trace's location in the run log.  Uses explicit
+    ``start_trace``/``stop_trace`` rather than the ``trace`` context manager
+    so a mid-region exception still stops the profiler (the capture up to
+    the failure survives on disk — often exactly the evidence wanted).
+    """
     if not profile_dir:
-        yield
+        yield None
         return
-    with jax.profiler.trace(profile_dir):
+    jax.profiler.start_trace(profile_dir)
+    try:
         with jax.profiler.TraceAnnotation(name):
-            yield
+            yield profile_dir
+    finally:
+        jax.profiler.stop_trace()
 
 
 def annotate(name: str):

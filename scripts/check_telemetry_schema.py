@@ -77,6 +77,11 @@ SCHEMA = {
         None,
     ),
     "hbm": ({"devices": dict}, {"task_id": NUM}, None),
+    "profile_trace": (
+        {"path": str},
+        {"task_id": NUM, "name": str},
+        None,
+    ),
     "recompile": (
         {
             "where": str,

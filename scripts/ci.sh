@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
-# One-shot CI: telemetry-schema lint over the committed evidence logs, then
-# the tier-1 test suite (the exact ROADMAP.md command).  Run from anywhere:
+# One-shot CI: telemetry-schema lint over the committed evidence logs, a CPU
+# prefetch determinism smoke, then the tier-1 test suite (the exact
+# ROADMAP.md command).  Run from anywhere:
 #
 #   bash scripts/ci.sh
 #
@@ -8,10 +9,16 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/2: telemetry schema lint =="
+echo "== stage 1/3: telemetry schema lint =="
 python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
 
-echo "== stage 2/2: tier-1 tests =="
+echo "== stage 2/3: CPU prefetch smoke (depth 2 ≡ depth 0) =="
+# Two-task synthetic run on the per-batch step path at --prefetch_depth 2;
+# its accuracy matrix must match a depth-0 run exactly (the asynchronous
+# input pipeline's determinism guarantee, data/prefetch.py).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/prefetch_smoke.py || exit 1
+
+echo "== stage 3/3: tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""CPU prefetch smoke: depth-2 must reproduce depth-0 exactly.
+
+Runs the same tiny 2-task synthetic protocol twice — synchronously
+(``prefetch_depth=0``) and double-buffered (``prefetch_depth=2``) — on the
+per-batch step path (``fused_epochs=False``), so all three prefetching
+consumers (train step loop, eval, herding feature pass) execute for real.
+The accuracy matrices must be **identical**: the prefetcher's determinism
+guarantee (byte-identical batch streams) is a testable property, not a
+comment.  Exit 0 on exact match, 1 otherwise, one JSON line either way.
+
+Used by ``scripts/ci.sh``; runnable standalone from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (
+        force_platform,
+    )
+
+    # Same persistent compile cache as the test suite: the smoke must not
+    # repay the XLA:CPU compile of programs the tier-1 run already built.
+    force_platform(
+        "cpu", compile_cache_dir=os.path.join(_REPO, "tests", ".jax_cache")
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import (
+        CilConfig,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import (
+        CilTrainer,
+    )
+
+    base = dict(
+        data_set="synthetic10",
+        num_bases=0,
+        increment=5,
+        backbone="resnet20",
+        batch_size=16,
+        num_epochs=2,
+        eval_every_epoch=100,
+        memory_size=40,
+        lr=0.05,
+        aa=None,
+        color_jitter=0.0,
+        seed=7,
+        fused_epochs=False,  # the per-batch path is what prefetching covers
+    )
+    matrices = {}
+    for depth in (0, 2):
+        trainer = CilTrainer(
+            CilConfig(**base, prefetch_depth=depth), init_dist=False
+        )
+        matrices[depth] = trainer.fit()["acc_matrix"]
+    identical = matrices[0] == matrices[2]
+    print(
+        json.dumps(
+            {
+                "metric": "prefetch_smoke",
+                "identical": identical,
+                "acc_matrix_depth0": matrices[0],
+                "acc_matrix_depth2": matrices[2],
+            }
+        )
+    )
+    return 0 if identical else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""Asynchronous input-pipeline prefetcher (``data/prefetch.py``).
+
+The guarantees under test are the module's contract: byte-identical batch
+streams vs the synchronous loaders (across process shards), producer
+exception propagation, clean thread shutdown on early exit, depth-0
+passthrough, and the residual-only StallClock accounting with ring
+occupancy reporting.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.data import (
+    DevicePrefetcher,
+    eval_batches,
+    sequential_batches,
+    train_batches,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.scenario import (
+    TaskSet,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+    StallClock,
+)
+
+
+def _toy_task(n=37):
+    y = np.arange(n, dtype=np.int64) % 5
+    x = np.zeros((n, 4, 4, 3), np.uint8)
+    x[:, 0, 0, 0] = np.arange(n)  # row-identifying pixel
+    return TaskSet(x=x, y=y, t=np.zeros(n, np.int64))
+
+
+def _collect(batches):
+    return [tuple(np.asarray(a).copy() for a in b) for b in batches]
+
+
+def _assert_streams_equal(sync, pre):
+    assert len(sync) == len(pre)
+    for bs, bp in zip(sync, pre):
+        assert len(bs) == len(bp)
+        for a, b in zip(bs, bp):
+            np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------------------------------- #
+# Stream equivalence vs the synchronous loaders, across process shards
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("pidx,pcount", [(0, 1), (0, 2), (1, 2)])
+@pytest.mark.parametrize("depth", [1, 3])
+def test_train_stream_identical_across_shards(pidx, pcount, depth):
+    task = _toy_task()
+    sync = _collect(train_batches(task, 8, seed=123, process_index=pidx,
+                                  process_count=pcount))
+    with DevicePrefetcher(
+        train_batches(task, 8, seed=123, process_index=pidx,
+                      process_count=pcount),
+        depth=depth,
+    ) as p:
+        pre = _collect(p)
+    _assert_streams_equal(sync, pre)
+
+
+@pytest.mark.parametrize("pidx,pcount", [(0, 1), (1, 2)])
+def test_eval_stream_identical_across_shards(pidx, pcount):
+    task = _toy_task()
+    sync = _collect(eval_batches(task, 8, pidx, pcount))
+    with DevicePrefetcher(eval_batches(task, 8, pidx, pcount), depth=4) as p:
+        pre = _collect(p)
+    _assert_streams_equal(sync, pre)
+
+
+def test_sequential_stream_identical():
+    task = _toy_task()
+    sync = _collect(sequential_batches(task, 8))
+    with DevicePrefetcher(sequential_batches(task, 8), depth=2) as p:
+        pre = _collect(p)
+    _assert_streams_equal(sync, pre)
+
+
+def test_place_applied_in_order():
+    with DevicePrefetcher(iter(range(50)), lambda v: v * 3, depth=4) as p:
+        assert list(p) == [v * 3 for v in range(50)]
+
+
+# --------------------------------------------------------------------------- #
+# Depth-0 passthrough
+# --------------------------------------------------------------------------- #
+
+
+def test_depth0_is_synchronous_passthrough():
+    marks = []
+
+    def place(v):
+        marks.append(threading.current_thread() is threading.main_thread())
+        return v
+
+    p = DevicePrefetcher(iter(range(5)), place, depth=0)
+    assert p._thread is None  # no producer thread at all
+    assert list(p) == list(range(5))
+    assert all(marks)  # placement ran inline on the consumer thread
+
+
+def test_depth0_charges_full_production_to_clock():
+    clock = StallClock()
+
+    def slow_place(v):
+        time.sleep(0.01)
+        return v
+
+    with DevicePrefetcher(iter(range(5)), slow_place, 0, clock=clock) as p:
+        list(p)
+    assert clock.host_s >= 0.05  # all 5 placements are host time
+    assert clock.prefetch_depth is None  # no ring, no occupancy fields
+    assert "prefetch_depth" not in clock.snapshot()
+
+
+# --------------------------------------------------------------------------- #
+# Exception propagation and shutdown
+# --------------------------------------------------------------------------- #
+
+
+def test_producer_source_exception_propagates():
+    def bad():
+        yield 1
+        raise ValueError("boom in source")
+
+    p = DevicePrefetcher(bad(), depth=2)
+    assert next(iter(p)) == 1
+    with pytest.raises(ValueError, match="boom in source"):
+        next(iter(p))
+    assert p._thread is None  # producer joined before the raise surfaced
+
+
+def test_producer_place_exception_propagates():
+    def bad_place(v):
+        if v == 3:
+            raise RuntimeError("boom in place")
+        return v
+
+    with DevicePrefetcher(iter(range(10)), bad_place, depth=2) as p:
+        with pytest.raises(RuntimeError, match="boom in place"):
+            list(p)
+
+
+def test_early_exit_joins_thread_and_drops_buffers():
+    def forever():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    before = threading.active_count()
+    p = DevicePrefetcher(forever(), depth=4)
+    it = iter(p)
+    assert [next(it), next(it)] == [0, 1]
+    thread = p._thread
+    p.close()
+    assert p._thread is None and not thread.is_alive()
+    assert threading.active_count() == before
+    assert p._queue.qsize() == 0  # prefetched items released
+    with pytest.raises(StopIteration):
+        next(it)  # closed iterator is exhausted, not wedged
+
+
+def test_close_is_idempotent_and_context_manager_closes():
+    with DevicePrefetcher(iter(range(3)), depth=2) as p:
+        next(iter(p))
+    assert p._thread is None
+    p.close()  # second close is a no-op
+
+
+def test_exhaustion_closes_thread():
+    p = DevicePrefetcher(iter(range(4)), depth=2)
+    assert list(p) == [0, 1, 2, 3]
+    assert p._thread is None
+
+
+# --------------------------------------------------------------------------- #
+# Residual accounting + occupancy
+# --------------------------------------------------------------------------- #
+
+
+def test_slow_consumer_reports_high_occupancy_low_residual():
+    clock = StallClock()
+    with DevicePrefetcher(iter(range(12)), depth=4, clock=clock) as p:
+        for _ in p:
+            time.sleep(0.005)  # consumer is the bottleneck
+    assert clock.prefetch_depth == 4
+    assert clock.prefetch_occupancy > 0.5  # producer stayed ahead
+    assert clock.host_s < 0.03  # residual only, not 12 productions
+    snap = clock.snapshot()
+    assert snap["prefetch_depth"] == 4
+    assert 0.0 <= snap["prefetch_depth_occupancy"] <= 1.0
+
+
+def test_slow_producer_reports_low_occupancy():
+    def slow_place(v):
+        time.sleep(0.005)
+        return v
+
+    clock = StallClock()
+    with DevicePrefetcher(
+        iter(range(12)), slow_place, depth=4, clock=clock
+    ) as p:
+        consumed = list(p)
+    assert consumed == list(range(12))
+    assert clock.prefetch_occupancy < 0.5  # ring kept running dry
+    assert clock.host_s > 0.02  # the waits are charged as residual host time
