@@ -148,6 +148,15 @@ class CilConfig:
     ckpt_backend: str = "pickle"  # "orbax": sharded tensorstore writes/restores
     resume: bool = False
 
+    # Runtime contracts (analysis/runtime.py; see README "Static analysis")
+    recompile_budget: bool = False  # RecompileSentinel: train programs may
+    # trace at most once per (task-growth, checkpoint-restore) event; a
+    # silent re-trace raises RecompileBudgetExceeded at the task boundary
+    check_donation: bool = False  # after a checkpoint restore, assert the
+    # device state shares no buffers with the host payload (the PR 3
+    # zero-copy aliasing SIGBUS), then poison the dead host copies so any
+    # missed alias fails as NaNs immediately
+
     # Profiling (SURVEY.md §5: absent in the reference; near-free here)
     profile_dir: Optional[str] = None  # trace each task's first epoch
     log_file: Optional[str] = None  # structured JSONL experiment log
@@ -255,6 +264,16 @@ def get_args_parser() -> argparse.ArgumentParser:
                    "shards via tensorstore; restore places arrays directly "
                    "onto the mesh sharding (no host gather)")
     p.add_argument("--resume", action="store_true", default=False)
+    p.add_argument("--recompile_budget", action="store_true", default=False,
+                   help="enforce the RecompileSentinel trace budget: train "
+                   "programs may compile at most once per task growth or "
+                   "checkpoint restore; a silent re-trace fails the run "
+                   "(analysis/runtime.py)")
+    p.add_argument("--check_donation", action="store_true", default=False,
+                   help="after a checkpoint restore, assert restored device "
+                   "arrays share no buffers with the host payload and poison "
+                   "the dead host copies (turns silent zero-copy aliasing "
+                   "into a deterministic failure)")
     p.add_argument("--profile_dir", default=None, type=str,
                    help="write a jax.profiler trace of each task's first epoch")
     p.add_argument("--log_file", default=None, type=str,
@@ -349,6 +368,8 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         ckpt_dir=args.ckpt_dir,
         ckpt_backend=args.ckpt_backend,
         resume=args.resume,
+        recompile_budget=args.recompile_budget,
+        check_donation=args.check_donation,
         profile_dir=args.profile_dir,
         log_file=args.log_file,
         telemetry_dir=args.telemetry_dir,

@@ -252,6 +252,18 @@ class CilTrainer:
             rc.track(f"epoch_fn[teacher={ht}]", fn, group="train")
         rc.track("eval_step", self.eval_step, group="eval")
         rc.track("feature_step", self.feature_step, group="feature")
+        # Opt-in runtime contract (--recompile_budget): train programs may
+        # trace at most once per (task-growth, restore) event; a silent
+        # re-trace raises at the task boundary instead of quietly doubling
+        # compile time on hardware.  Created before the resume block below so
+        # a checkpoint restore is counted as a budget-granting event.
+        self.recompile_sentinel = None
+        if config.recompile_budget:
+            from analysis.runtime import RecompileSentinel
+
+            self.recompile_sentinel = RecompileSentinel(
+                rc, group="train", per_event=1, sink=self.jsonl
+            )
         # Armed by _grow_state: a growth changes the head shape, so the next
         # eval/feature compile is expected rather than a leak.
         self._eval_fresh_shapes = True
@@ -353,6 +365,12 @@ class CilTrainer:
                     )
                 t0 = time.time()
                 self._fit_task(task_id, task_train, dataset_val)
+                if self.recompile_sentinel is not None:
+                    # All legitimate train compiles for this task happened;
+                    # anything beyond the granted budget is a leak.
+                    self.recompile_sentinel.check(
+                        where=f"task{task_id}", task_id=task_id
+                    )
 
                 # Weight alignment after training, tasks > 0
                 # (template.py:285-286).
@@ -472,6 +490,8 @@ class CilTrainer:
         # next compile is expected, not a leak.
         self._eval_fresh_shapes = True
         self._feature_fresh_shapes = True
+        if self.recompile_sentinel is not None:
+            self.recompile_sentinel.note_event("task_growth", task_id=task_id)
         return state.replace(
             params=params,
             momentum=sgd_init(params),  # fresh SGD per task (template.py:246)

@@ -121,7 +121,11 @@ class Heartbeat:
             # (the watchdog) sees either the old or the new beat, never a
             # torn write.
             os.replace(tmp, self.path)
-            self._last_write = time.monotonic()
+            # Under the lock: _write runs on both the daemon thread and the
+            # training loop (update/stop), and update() reads this stamp to
+            # decide cadence (jaxlint JL301).
+            with self._lock:
+                self._last_write = time.monotonic()
         except OSError:
             # A full disk must not kill training; staleness is the signal.
             try:

@@ -183,6 +183,17 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
         # programs must never be handed.
         params = jax.tree_util.tree_map(jnp.copy, restored["params"])
         batch_stats = jax.tree_util.tree_map(jnp.copy, restored["batch_stats"])
+        if getattr(trainer.config, "check_donation", False):
+            # Same contract as the pickle branch below: the state the trainer
+            # keeps must not share buffers with the checkpoint reader's own
+            # arrays, which the donating programs would otherwise free.
+            from analysis.runtime import assert_unaliased
+
+            assert_unaliased(
+                restored,
+                {"params": params, "batch_stats": batch_stats},
+                where=path,
+            )
     else:
         # jnp.copy after placement is load-bearing: on CPU, device_put of an
         # aligned host array is zero-copy, so the jax.Array would alias the
@@ -199,6 +210,21 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
         batch_stats = jax.tree_util.tree_map(
             jnp.copy, shard_params(trainer.mesh, payload["batch_stats"])
         )
+    if getattr(trainer.config, "check_donation", False):
+        # Opt-in contract: prove the copies above actually re-homed every
+        # leaf (no device array aliases the unpickled host buffers), then
+        # poison the dead host payload — a surviving alias then fails as
+        # NaN metrics at the restore point instead of SIGBUS epochs later.
+        from analysis.runtime import assert_unaliased, poison_host_tree
+
+        host_state = {k: payload[k] for k in ("params", "batch_stats")
+                      if k in payload}
+        assert_unaliased(
+            host_state,
+            {"params": params, "batch_stats": batch_stats},
+            where=path,
+        )
+        poison_host_tree(host_state)
     known = int(payload["known"])
     trainer.state = trainer.state.replace(
         params=params,
@@ -228,5 +254,9 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
     trainer.acc_matrix = matrix
     trainer.memory._store = payload["memory_store"]
     trainer.start_task = payload["task_id"] + 1
+    sentinel = getattr(trainer, "recompile_sentinel", None)
+    if sentinel is not None:
+        # A restore legitimately (re)compiles the resumed task's programs.
+        sentinel.note_event("restore", task_id=payload["task_id"])
     print(f"| resumed from {path}: next task {trainer.start_task}, known={known}")
     return True

@@ -1,0 +1,33 @@
+"""Static + runtime contracts for the JAX hazards this repo has actually hit.
+
+Two halves:
+
+* ``jaxlint`` (``analysis.linter`` + ``analysis.rules``): an AST pass over the
+  training package, ``scripts/``, ``bench.py`` and ``train.py`` that flags the
+  bug classes PR 2 and PR 3 shipped fixes for — donation misuse (JL001/JL002),
+  recompile hazards (JL101/JL102), host syncs in device hot loops (JL201) and
+  thread-shared state mutated outside a lock (JL301).  Stdlib-only: the CI
+  lint stage must run without importing jax.
+* runtime contracts (``analysis.runtime``): ``RecompileSentinel`` (a trace
+  budget on top of the telemetry recompile counter) and donation-aliasing
+  helpers (``buffer_aliases`` / ``assert_unaliased`` / ``poison_host_tree``)
+  behind ``--check_donation``.  Imports jax lazily, only when used.
+
+``analysis.runtime`` is deliberately NOT imported here so that
+``import analysis`` stays dependency-free.
+"""
+
+from .findings import Baseline, Finding, is_suppressed, parse_suppressions
+from .linter import DEFAULT_TARGETS, lint_file, lint_paths
+from .rules import RULES
+
+__all__ = [
+    "Baseline",
+    "DEFAULT_TARGETS",
+    "Finding",
+    "RULES",
+    "is_suppressed",
+    "lint_file",
+    "lint_paths",
+    "parse_suppressions",
+]

@@ -1,0 +1,119 @@
+"""Finding records, inline suppressions, and the committed baseline.
+
+A finding is ``path:line:col: RULE message``.  Two escape hatches keep the CI
+gate honest instead of noisy:
+
+* inline: a ``# jaxlint: disable=JL001`` (comma-separated, or ``all``) on the
+  offending line suppresses just that line;
+* baseline: ``analysis/jaxlint_baseline.json`` carries accepted findings with
+  a written justification.  The gate fails only on findings *not* in the
+  baseline, and reports stale entries so the file shrinks over time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    rule: str  # "JL001" ... "JL301"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    @property
+    def key(self) -> Tuple[str, str, int]:
+        return (self.path, self.rule, self.line)
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """line number -> set of rule ids disabled on that line (``all`` allowed)."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[lineno] = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+    return out
+
+
+def is_suppressed(finding: Finding, suppressions: Dict[int, Set[str]]) -> bool:
+    rules = suppressions.get(finding.line)
+    return bool(rules) and (finding.rule in rules or "all" in rules)
+
+
+class Baseline:
+    """The accepted-findings inventory, persisted as JSON.
+
+    Matching is exact on (path, rule, line): a baselined finding that moves
+    goes stale and must be re-justified (or fixed), which is the point.
+    """
+
+    def __init__(self, entries: Iterable[dict] = ()):  # each: path/rule/line/reason
+        self.entries: List[dict] = [dict(e) for e in entries]
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    def _keys(self) -> Set[Tuple[str, str, int]]:
+        return {(e["path"], e["rule"], int(e["line"])) for e in self.entries}
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """(new, baselined, stale_entries)."""
+        keys = self._keys()
+        seen: Set[Tuple[str, str, int]] = set()
+        new: List[Finding] = []
+        known: List[Finding] = []
+        for f in findings:
+            if f.key in keys:
+                known.append(f)
+                seen.add(f.key)
+            else:
+                new.append(f)
+        stale = [e for e in self.entries
+                 if (e["path"], e["rule"], int(e["line"])) not in seen]
+        return new, known, stale
+
+    def write(self, path: str, findings: Iterable[Finding]) -> None:
+        """Refresh the baseline to exactly the current findings, keeping the
+        written reason of any entry that still matches."""
+        reasons = {(e["path"], e["rule"], int(e["line"])): e.get("reason", "")
+                   for e in self.entries}
+        entries = [
+            {
+                "path": f.path,
+                "rule": f.rule,
+                "line": f.line,
+                "reason": reasons.get(f.key, "TODO: justify or fix"),
+                "message": f.message,
+            }
+            for f in sorted(set(findings), key=lambda f: f.key)
+        ]
+        payload = {
+            "comment": "Accepted jaxlint findings. Every entry needs a reason; "
+                       "refresh with: python scripts/jaxlint.py --write-baseline",
+            "findings": entries,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)
+        self.entries = entries

@@ -1,0 +1,95 @@
+"""jaxlint driver: file discovery, parsing, suppression + baseline filtering.
+
+Programmatic API (the CLI lives in ``scripts/jaxlint.py``)::
+
+    from analysis import lint_paths
+    findings = lint_paths(["a_pytorch_tutorial_to_class_incremental_learning_tpu"],
+                          root="/repo")
+
+Findings come back sorted, already filtered by inline suppressions but NOT by
+the baseline — callers split against the baseline themselves so the CLI can
+report new/baselined/stale separately.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Tuple
+
+from .findings import Finding, is_suppressed, parse_suppressions
+from .rules import ProjectIndex, run_rules
+
+DEFAULT_TARGETS = (
+    "a_pytorch_tutorial_to_class_incremental_learning_tpu",
+    "scripts",
+    "bench.py",
+    "train.py",
+)
+DEFAULT_BASELINE = os.path.join("analysis", "jaxlint_baseline.json")
+
+_SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", "node_modules", ".venv"}
+
+
+def discover(paths: Iterable[str], root: str) -> List[str]:
+    """Absolute paths of every ``.py`` file under ``paths`` (relative to
+    ``root``), sorted and de-duplicated."""
+    out = set()
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                out.add(os.path.abspath(full))
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d not in _SKIP_DIRS and not d.startswith(".")]
+                for name in filenames:
+                    if name.endswith(".py"):
+                        out.add(os.path.abspath(os.path.join(dirpath, name)))
+    return sorted(out)
+
+
+def _relpath(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    return rel.replace(os.sep, "/")
+
+
+def lint_paths(paths: Iterable[str], root: str = ".") -> List[Finding]:
+    root = os.path.abspath(root)
+    paths = list(paths)
+    files = discover(paths, root)
+    modules: List[Tuple[str, str, ast.Module]] = []
+    findings: List[Finding] = []
+    # An explicitly-requested path that resolves to nothing is an error, not
+    # a clean run — `jaxlint typo.py` must not exit 0.
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            findings.append(Finding(p.replace(os.sep, "/"), 1, 0, "JL000",
+                                    "path does not exist"))
+    for path in files:
+        rel = _relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            findings.append(Finding(rel, line, 0, "JL000",
+                                    f"does not parse: {e.__class__.__name__}: {e}"))
+            continue
+        modules.append((rel, source, tree))
+    index = ProjectIndex.build((rel, tree) for rel, _, tree in modules)
+    for rel, source, tree in modules:
+        supp = parse_suppressions(source)
+        for f in run_rules(rel, tree, index):
+            if not is_suppressed(f, supp):
+                findings.append(f)
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_file(path: str, root: str = ".") -> List[Finding]:
+    """Lint a single file (fixture-sized projects: the project index is built
+    from just this file)."""
+    return lint_paths([path], root=root)

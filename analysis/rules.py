@@ -1,0 +1,869 @@
+"""AST rules for jaxlint.  Stdlib-only — the CI gate must not import jax.
+
+Rule catalog (see README "Static analysis"):
+
+* JL001 — donation safety: a binding passed at a ``donate_argnums`` position
+  of a jitted program is dead afterwards; flag reads of it after the call,
+  and object attributes (``trainer.state``) left pointing at donated buffers
+  at function exit.
+* JL002 — restore aliasing: host buffers from ``pickle.load`` / orbax
+  ``.restore`` / ``np.load`` reaching a donating program (or a TrainState)
+  without an intervening ``jnp.copy``.  This is the exact PR 3 SIGBUS.
+* JL101 — uncommitted scalar: ``num_active=`` / ``known=`` built from a bare
+  Python/jnp scalar instead of ``replicated_scalar`` (the PR 2 recompile
+  leak: an uncommitted scalar re-traces every program on its second call).
+* JL102 — branch-on-tracer: ``if``/``while`` on a traced parameter of a
+  jitted function (``is None`` and ``isinstance`` checks are static and
+  allowed; ``static_argnums`` positions are excluded).
+* JL201 — host sync in a device hot loop: ``.item()`` / ``float()`` /
+  ``np.asarray`` / ``jax.device_get`` inside a ``for ... in <batches>`` loop.
+* JL301 — thread-shared state: a ``self.*`` attribute written by both the
+  producer thread target and consumer methods without holding the lock.
+
+The donation pass is a light abstract interpreter: it tracks which local
+names/attributes are bound to donating callables (including builder
+functions that *return* donating jits, ``.lower(...).compile()`` chains,
+dict containers of donating callables, and donating callables received as
+parameters or returned in tuples), which dotted names are currently donated,
+simple aliases (``x = obj.attr``), and which values are tainted by a
+checkpoint restore.  It is intentionally name-based and per-function — a
+linter, not a verifier: precise enough that the real tree is clean and the
+bug classes we have actually shipped are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+RULES: Dict[str, str] = {
+    "JL000": "file does not parse",
+    "JL001": "read or escape of a buffer after it was donated to a jit program",
+    "JL002": "restored host buffer flows into a donating program without jnp.copy",
+    "JL101": "uncommitted Python scalar where replicated_scalar is required",
+    "JL102": "branch on a traced value inside a jitted function",
+    "JL201": "host sync inside a device hot loop",
+    "JL301": "attribute written by producer thread and consumer outside the lock",
+}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_positions(node: ast.AST) -> FrozenSet[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = {e.value for e in node.elts
+               if isinstance(e, ast.Constant) and isinstance(e.value, int)}
+        if out:
+            return frozenset(out)
+    return frozenset({0})  # unknown literal: assume the conventional arg 0
+
+
+def donate_positions(call: ast.Call) -> Optional[FrozenSet[int]]:
+    """donate_argnums of a ``jax.jit(...)`` call, or None when not donating."""
+    if dotted(call.func) not in _JIT_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return _int_positions(kw.value)
+    return None
+
+
+def static_positions(call: ast.Call) -> FrozenSet[int]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            return _int_positions(kw.value)
+    return frozenset()
+
+
+def imports_jax(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] in ("jax", "jaxlib") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").split(".")[0]
+            if root in ("jax", "jaxlib") or node.level > 0:
+                return True
+    return False
+
+
+# --------------------------------------------------------------------------- #
+# Project index: donating builders and donating attributes, across modules
+# --------------------------------------------------------------------------- #
+
+
+class ProjectIndex:
+    """Name-keyed cross-module knowledge the per-module flow pass consults.
+
+    * ``builders``: functions whose return value is a donating jit
+      (``make_train_step`` -> {0}).  Calling one *yields* a donating callable.
+    * ``donating_attrs``: attribute names assigned a donating callable or a
+      dict of them anywhere in the project (``self._steps`` in loop.py), so
+      ``trainer._steps[ht](state, ...)`` donates in every module.
+    """
+
+    def __init__(self) -> None:
+        self.builders: Dict[str, FrozenSet[int]] = {}
+        self.donating_attrs: Dict[str, Tuple[str, FrozenSet[int]]] = {}
+
+    @classmethod
+    def build(cls, modules: Iterable[Tuple[str, ast.Module]]) -> "ProjectIndex":
+        idx = cls()
+        mods = list(modules)
+        for _, tree in mods:
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Call):
+                            pos = donate_positions(sub.value)
+                            if pos is not None:
+                                idx.builders[node.name] = pos
+        for _, tree in mods:  # second sweep: builders are known project-wide
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    tgt, val = node.target, node.value
+                else:
+                    continue
+                if not isinstance(tgt, ast.Attribute):
+                    continue
+                kind = idx.value_donating(val)
+                if kind is not None:
+                    idx.donating_attrs[tgt.attr] = kind
+        return idx
+
+    def value_donating(self, val: ast.AST) -> Optional[Tuple[str, FrozenSet[int]]]:
+        if isinstance(val, ast.Call):
+            pos = donate_positions(val)
+            if pos is not None:
+                return ("callable", pos)
+            name = dotted(val.func)
+            if name and name.split(".")[-1] in self.builders:
+                return ("callable", self.builders[name.split(".")[-1]])
+        if isinstance(val, ast.Dict):
+            kinds = [self.value_donating(v) for v in val.values if v is not None]
+            if kinds and all(k is not None for k in kinds):
+                return ("container", kinds[0][1])  # type: ignore[index]
+        if isinstance(val, ast.DictComp):
+            kind = self.value_donating(val.value)
+            if kind is not None:
+                return ("container", kind[1])
+        return None
+
+
+# --------------------------------------------------------------------------- #
+# JL001 + JL002: donation-flow pass
+# --------------------------------------------------------------------------- #
+
+# Calls whose result may share memory with (taint through) their array args.
+_TAINT_PROPAGATORS = {
+    "asarray", "device_put", "shard_params", "ravel", "reshape", "view",
+    "make_array_from_process_local_data", "frombuffer", "squeeze",
+}
+# Calls that re-home / scalarize: their result no longer aliases the input.
+_TAINT_SANITIZERS = {
+    "copy", "deepcopy", "array", "int", "float", "bool", "str", "len",
+    "list", "dict", "tuple", "zeros_like", "ones_like", "device_get",
+}
+_TAINT_SOURCES = {"pickle.load", "pickle.loads", "np.load", "numpy.load",
+                  "joblib.load"}
+
+
+class _FnSummary:
+    __slots__ = ("node", "donating_params", "ret_don")
+
+    def __init__(self, node: ast.AST) -> None:
+        self.node = node
+        self.donating_params: Set[int] = set()
+        # tuple index -> donate positions of the returned callable; -1 = whole
+        self.ret_don: Dict[int, FrozenSet[int]] = {}
+
+
+class DonationPass:
+    """Two passes over a module: pass 1 builds function summaries and records
+    which call sites hand donating callables to which parameters; pass 2
+    re-runs with those seeds and emits findings."""
+
+    def __init__(self, path: str, tree: ast.Module, index: ProjectIndex,
+                 out: List[Finding]) -> None:
+        self.path = path
+        self.tree = tree
+        self.index = index
+        self.out = out
+        self.emit = False
+        self.call_seeds: Dict[int, Dict[int, FrozenSet[int]]] = {}  # id(fnode)
+        self._emitted: Set[Tuple[int, int, str]] = set()
+
+    def run(self) -> None:
+        for emit in (False, True):
+            self.emit = emit
+            _Scope(self, None, {}, ()).exec_block(self.tree.body)
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        if not self.emit:
+            return
+        # No message in the key: `state` and `state.params` at one position
+        # are the same defect, and ast.walk yields the more specific
+        # (outermost) node first.
+        key = (node.lineno, node.col_offset, rule)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.out.append(Finding(self.path, node.lineno, node.col_offset, rule, message))
+
+
+class _Scope:
+    """Symbolic execution of one function body (or the module body)."""
+
+    def __init__(self, dpass: DonationPass, fnode, closure_bindings: Dict,
+                 params: Tuple[str, ...]) -> None:
+        self.p = dpass
+        self.fnode = fnode
+        self.bind: Dict[str, tuple] = dict(closure_bindings)
+        self.params = params
+        self.donated: Dict[str, ast.AST] = {}   # dotted -> donating call node
+        self.aliases: Dict[str, Set[str]] = {}
+        self.tainted: Set[str] = set()
+        self.summary = _FnSummary(fnode)
+        if fnode is not None:
+            seeds = dpass.call_seeds.get(id(fnode), {})
+            for i, pos in seeds.items():
+                if i < len(params):
+                    self.bind[params[i]] = ("don", pos)
+
+    # ---- statement dispatch ------------------------------------------- #
+
+    def exec_block(self, stmts: List[ast.stmt]) -> None:
+        for st in stmts:
+            self.exec_stmt(st)
+
+    def exec_stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.define_function(st)
+        elif isinstance(st, ast.ClassDef):
+            self.exec_block(st.body)
+        elif isinstance(st, ast.Assign):
+            self.handle_assign(st.targets, st.value)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.handle_assign([st.target], st.value)
+        elif isinstance(st, ast.AugAssign):
+            self.effects(st.value)
+            self.check_reads(st.target)
+        elif isinstance(st, ast.Expr):
+            self.effects(st.value)
+        elif isinstance(st, ast.Return):
+            self.handle_return(st)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.effects(st.iter)
+            for tname in self._target_names(st.target):
+                self.revive(tname)
+            # Twice: catches use-in-next-iteration of a name donated by the
+            # first pass (findings are deduplicated).
+            self.exec_block(st.body)
+            self.exec_block(st.body)
+            self.exec_block(st.orelse)
+        elif isinstance(st, ast.While):
+            self.effects(st.test)
+            self.exec_block(st.body)
+            self.exec_block(st.body)
+            self.exec_block(st.orelse)
+        elif isinstance(st, ast.If):
+            self.effects(st.test)
+            self.exec_block(st.body)
+            self.exec_block(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.effects(item.context_expr)
+                if item.optional_vars is not None:
+                    for tname in self._target_names(item.optional_vars):
+                        self.revive(tname)
+            self.exec_block(st.body)
+        elif isinstance(st, ast.Try):
+            self.exec_block(st.body)
+            for h in st.handlers:
+                self.exec_block(h.body)
+            self.exec_block(st.orelse)
+            self.exec_block(st.finalbody)
+        else:
+            for value in ast.iter_child_nodes(st):
+                if isinstance(value, ast.expr):
+                    self.effects(value)
+
+    def define_function(self, fnode) -> None:
+        params = tuple(a.arg for a in fnode.args.args)
+        inner = _Scope(self.p, fnode, self.bind, params)
+        inner.exec_block(fnode.body)
+        inner.finish()
+        self.bind[fnode.name] = ("fn", inner.summary)
+
+    def finish(self) -> None:
+        """End-of-function escape check: an attribute of a parameter (or of
+        self) still pointing at donated buffers leaks dead arrays to the
+        caller — rebind it (``trainer.state = state``) before returning."""
+        for name, call in self.donated.items():
+            root = name.split(".")[0]
+            if "." in name and (root == "self" or root in self.params):
+                self.p.report(
+                    "JL001", call,
+                    f"`{name}` still refers to buffers donated here at function "
+                    f"exit; rebind it (e.g. `{name} = <new value>`) so callers "
+                    "never touch donated arrays",
+                )
+
+    # ---- expression effects ------------------------------------------- #
+
+    def handle_assign(self, targets: List[ast.expr], value: ast.expr) -> None:
+        kind = self.effects(value)
+        taint = self.expr_tainted(value)
+        src = dotted(value)  # plain `x = obj.attr` aliases, not a new buffer
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                kinds = kind[1] if kind and kind[0] == "tuple" else None
+                for i, el in enumerate(tgt.elts):
+                    name = dotted(el)
+                    if not name:
+                        continue
+                    self.revive(name)
+                    if kinds and i < len(kinds) and kinds[i]:
+                        self.bind[name] = kinds[i]
+                    if taint:
+                        self.tainted.add(name)
+            else:
+                name = dotted(tgt)
+                if isinstance(tgt, ast.Subscript):
+                    continue  # container element writes don't rebind the name
+                if not name:
+                    continue
+                self.revive(name)
+                if kind and kind[0] != "tuple":
+                    self.bind[name] = kind
+                if src and src != name:
+                    self.aliases.setdefault(name, set()).add(src)
+                    self.aliases.setdefault(src, set()).add(name)
+                    if src in self.tainted:
+                        taint = True
+                if taint:
+                    self.tainted.add(name)
+
+    def handle_return(self, st: ast.Return) -> None:
+        if st.value is None:
+            return
+        kind = self.effects(st.value)
+        if kind is None:
+            return
+        if kind[0] == "don":
+            self.summary.ret_don[-1] = kind[1]
+        elif kind[0] == "tuple":
+            for i, k in enumerate(kind[1]):
+                if k and k[0] == "don":
+                    self.summary.ret_don[i] = k[1]
+
+    def effects(self, value: ast.expr):
+        """Check reads against the donated set, apply donations/taints of every
+        call inside ``value``, and return the value's callable kind."""
+        self.check_reads(value)
+        for call in [n for n in ast.walk(value) if isinstance(n, ast.Call)]:
+            self.apply_call(call)
+        return self.eval_kind(value)
+
+    def check_reads(self, node: ast.expr) -> None:
+        if not self.donated:
+            return
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Name, ast.Attribute)):
+                continue
+            if isinstance(getattr(sub, "ctx", None), (ast.Store, ast.Del)):
+                continue
+            name = dotted(sub)
+            if not name:
+                continue
+            parts = name.split(".")
+            for k in range(1, len(parts) + 1):
+                prefix = ".".join(parts[:k])
+                if prefix in self.donated:
+                    self.p.report(
+                        "JL001", sub,
+                        f"`{name}` is read after `{prefix}` was donated to a "
+                        f"jitted program on line "
+                        f"{self.donated[prefix].lineno}; donated buffers are "
+                        "deleted — use the program's return value instead",
+                    )
+                    break
+
+    def apply_call(self, call: ast.Call) -> None:
+        pos = self.callee_donating(call)
+        if pos:
+            # Seed donating-callable parameters of locally-defined callees.
+            pass  # (seeding happens below for all calls)
+        self._seed_callee_params(call)
+        if pos:
+            for i in sorted(pos):
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                if self.expr_tainted(arg):
+                    self.p.report(
+                        "JL002", arg,
+                        "restored host buffer is passed at a donated argument "
+                        "position; on CPU device_put is zero-copy, so XLA would "
+                        "free a buffer it does not own (the PR 3 SIGBUS) — "
+                        "re-home it first: jax.tree_util.tree_map(jnp.copy, ...)",
+                    )
+                name = dotted(arg)
+                if name:
+                    self.donate(name, call)
+        self._check_state_sink(call)
+
+    def _seed_callee_params(self, call: ast.Call) -> None:
+        """`f(x)` where x is bound to a donating callable: mark f's parameter
+        as donating for the second pass (how ``compiled`` reaches
+        ``trace_crosscheck`` in bench.py)."""
+        fname = dotted(call.func)
+        target = self.bind.get(fname) if fname else None
+        if not target or target[0] != "fn":
+            return
+        fnode = target[1].node
+        for i, arg in enumerate(call.args):
+            k = self.arg_kind(arg)
+            if k and k[0] == "don":
+                self.p.call_seeds.setdefault(id(fnode), {})[i] = k[1]
+
+    def _check_state_sink(self, call: ast.Call) -> None:
+        """Tainted pytrees assigned into a TrainState (`.replace(params=...)`
+        or `TrainState(...)`) end up donated by the train programs later —
+        the cross-function half of JL002."""
+        fname = dotted(call.func) or ""
+        last = fname.split(".")[-1]
+        is_replace = last == "replace" and "state" in fname.lower()
+        is_ctor = last == "TrainState"
+        if not (is_replace or is_ctor):
+            return
+        for kw in call.keywords:
+            if kw.arg in ("params", "batch_stats", "momentum") and \
+                    self.expr_tainted(kw.value):
+                self.p.report(
+                    "JL002", kw.value,
+                    f"restored host buffer reaches `{last}({kw.arg}=...)` "
+                    "without jnp.copy; the donating train programs will free "
+                    "a buffer XLA does not own (the PR 3 SIGBUS) — re-home "
+                    "with jax.tree_util.tree_map(jnp.copy, ...)",
+                )
+
+    # ---- resolution helpers ------------------------------------------- #
+
+    def callee_donating(self, call: ast.Call) -> Optional[FrozenSet[int]]:
+        f = call.func
+        if isinstance(f, ast.Call):  # jax.jit(fn, donate_argnums=...)(args)
+            pos = donate_positions(f)
+            if pos is not None:
+                return pos
+        name = dotted(f)
+        if name:
+            k = self.bind.get(name)
+            if k:
+                if k[0] == "don":
+                    return k[1]
+                if k[0] == "fn" and k[1].donating_params:
+                    return frozenset(k[1].donating_params)
+        if isinstance(f, ast.Subscript):
+            base = dotted(f.value)
+            if base:
+                k = self.bind.get(base)
+                if k and k[0] == "cont":
+                    return k[1]
+                attr = base.split(".")[-1]
+                known = self.p.index.donating_attrs.get(attr)
+                if known and known[0] == "container":
+                    return known[1]
+        if isinstance(f, ast.Attribute):
+            known = self.p.index.donating_attrs.get(f.attr)
+            if known and known[0] == "callable":
+                return known[1]
+        return None
+
+    def arg_kind(self, node: ast.expr):
+        name = dotted(node)
+        if name:
+            return self.bind.get(name)
+        return self.eval_kind(node)
+
+    def eval_kind(self, node: ast.expr):
+        if isinstance(node, ast.Call):
+            pos = donate_positions(node)
+            if pos is not None:
+                return ("don", pos)
+            fname = dotted(node.func)
+            if fname:
+                short = fname.split(".")[-1]
+                if short in self.p.index.builders:
+                    return ("don", self.p.index.builders[short])
+                k = self.bind.get(fname)
+                if k and k[0] == "fn":
+                    s = k[1]
+                    if -1 in s.ret_don:
+                        return ("don", s.ret_don[-1])
+                    if s.ret_don:
+                        width = max(s.ret_don) + 1
+                        return ("tuple",
+                                [("don", s.ret_don[i]) if i in s.ret_don else None
+                                 for i in range(width)])
+            if isinstance(node.func, ast.Attribute):
+                base_kind = self.arg_kind(node.func.value)
+                if node.func.attr == "lower" and base_kind and base_kind[0] == "don":
+                    return ("lowered", base_kind[1])
+                if node.func.attr == "compile" and base_kind and \
+                        base_kind[0] == "lowered":
+                    return ("don", base_kind[1])
+            return None
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted(node)
+            return self.bind.get(name) if name else None
+        if isinstance(node, ast.Subscript):
+            base = dotted(node.value)
+            if base:
+                k = self.bind.get(base)
+                if k and k[0] == "cont":
+                    return ("don", k[1])
+                known = self.p.index.donating_attrs.get(base.split(".")[-1])
+                if known and known[0] == "container":
+                    return ("don", known[1])
+            return None
+        if isinstance(node, (ast.Dict, ast.DictComp)):
+            kind = self.p.index.value_donating(node)
+            if kind:
+                return ("cont", kind[1])
+            if isinstance(node, ast.Dict):
+                kinds = [self.eval_kind(v) for v in node.values if v is not None]
+                if kinds and all(k and k[0] == "don" for k in kinds):
+                    return ("cont", kinds[0][1])
+            if isinstance(node, ast.DictComp):
+                k = self.eval_kind(node.value)
+                if k and k[0] == "don":
+                    return ("cont", k[1])
+            return None
+        if isinstance(node, ast.Tuple):
+            return ("tuple", [self.eval_kind(e) for e in node.elts])
+        if isinstance(node, ast.IfExp):
+            return self.eval_kind(node.body) or self.eval_kind(node.orelse)
+        return None
+
+    def expr_tainted(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted(node)
+            if not name:
+                return False
+            parts = name.split(".")
+            return any(".".join(parts[:k]) in self.tainted
+                       for k in range(1, len(parts) + 1))
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            short = fname.split(".")[-1]
+            if fname in _TAINT_SOURCES or fname.endswith(".restore"):
+                return True
+            if short == "tree_map":
+                mapped = dotted(node.args[0]) if node.args else None
+                if mapped and mapped.split(".")[-1] in ("copy", "deepcopy"):
+                    return False
+                return any(self.expr_tainted(a) for a in node.args[1:])
+            if short in _TAINT_SANITIZERS:
+                return False
+            if short in _TAINT_PROPAGATORS:
+                return (any(self.expr_tainted(a) for a in node.args)
+                        or any(self.expr_tainted(k.value) for k in node.keywords))
+            return False
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.expr_tainted(v) for v in node.values if v is not None)
+        return False
+
+    # ---- donated-set mechanics ---------------------------------------- #
+
+    def donate(self, name: str, call: ast.Call) -> None:
+        for member in {name} | self.aliases.get(name, set()):
+            self.donated.setdefault(member, call)
+
+    def revive(self, name: str) -> None:
+        self.donated.pop(name, None)
+        self.tainted.discard(name)
+        for other in self.aliases.pop(name, set()):
+            self.aliases.get(other, set()).discard(name)
+
+    def _target_names(self, target: ast.expr) -> List[str]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for el in target.elts:
+                out.extend(self._target_names(el))
+            return out
+        name = dotted(target)
+        return [name] if name else []
+
+
+# --------------------------------------------------------------------------- #
+# JL101: uncommitted scalars where a committed array is required
+# --------------------------------------------------------------------------- #
+
+_COMMIT_KWARGS = ("num_active", "known")
+_COMMIT_RECEIVERS = ("TrainState", "Teacher")
+
+
+def run_scalar_commit(path: str, tree: ast.Module, out: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func) or ""
+        short = fname.split(".")[-1]
+        if short != "replace" and short not in _COMMIT_RECEIVERS:
+            continue
+        for kw in node.keywords:
+            if kw.arg in _COMMIT_KWARGS and _uncommitted(kw.value):
+                out.append(Finding(
+                    path, kw.value.lineno, kw.value.col_offset, "JL101",
+                    f"`{kw.arg}=` built from an uncommitted scalar: every "
+                    "program taking it re-traces on its second call (the PR 2 "
+                    "recompile leak) — commit it with replicated_scalar(mesh, v)",
+                ))
+
+
+def _uncommitted(v: ast.expr) -> bool:
+    if isinstance(v, ast.Constant):
+        return isinstance(v.value, (int, float)) and not isinstance(v.value, bool)
+    if isinstance(v, ast.Call):
+        fname = dotted(v.func) or ""
+        return not fname.endswith("replicated_scalar")
+    if isinstance(v, (ast.BinOp, ast.UnaryOp)):
+        return True
+    return False  # Name/Attribute/Subscript: assumed already committed
+
+
+# --------------------------------------------------------------------------- #
+# JL102: branch-on-tracer inside jitted functions
+# --------------------------------------------------------------------------- #
+
+
+def run_branch_on_tracer(path: str, tree: ast.Module, out: List[Finding]) -> None:
+    jitted: Dict[str, FrozenSet[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in _JIT_NAMES \
+                and node.args and isinstance(node.args[0], ast.Name):
+            jitted[node.args[0].id] = static_positions(node)
+    if not jitted:
+        return
+    fdefs = {n.name: n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name, static in jitted.items():
+        fn = fdefs.get(name)
+        if fn is None:
+            continue
+        traced = {a.arg for i, a in enumerate(fn.args.args) if i not in static}
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.If, ast.While)):
+                continue
+            if _static_test(sub.test):
+                continue
+            hit = sorted({n.id for n in ast.walk(sub.test)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Load)} & traced)
+            if hit:
+                out.append(Finding(
+                    path, sub.test.lineno, sub.test.col_offset, "JL102",
+                    f"Python branch on traced value(s) {', '.join(hit)} inside "
+                    f"jitted `{name}`: this re-traces per value (or raises a "
+                    "ConcretizationTypeError) — use jnp.where/lax.cond, or "
+                    "mark the argument static",
+                ))
+
+
+def _static_test(test: ast.expr) -> bool:
+    if isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return True
+    if isinstance(test, ast.Call) and \
+            (dotted(test.func) or "").split(".")[-1] == "isinstance":
+        return True
+    if isinstance(test, ast.BoolOp):
+        return all(_static_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _static_test(test.operand)
+    return False
+
+
+# --------------------------------------------------------------------------- #
+# JL201: host syncs inside device hot loops
+# --------------------------------------------------------------------------- #
+
+_HOT_ITER_MARKERS = ("batch", "prefetch")
+_HOST_FETCHERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+                  "jax.device_get", "device_get"}
+
+
+def run_host_sync(path: str, tree: ast.Module, out: List[Finding]) -> None:
+    if not imports_jax(tree):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For):
+            continue
+        try:
+            it = ast.unparse(node.iter).lower()
+        except Exception:  # pragma: no cover - unparse of exotic nodes
+            continue
+        if not any(m in it for m in _HOT_ITER_MARKERS):
+            continue
+        for sub in _walk_no_defs(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            msg = None
+            fname = dotted(sub.func) or ""
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr == "item" \
+                    and not sub.args:
+                msg = "`.item()` synchronizes host and device every step"
+            elif fname in _HOST_FETCHERS:
+                msg = f"`{fname}(...)` fetches device data to host every step"
+            elif isinstance(sub.func, ast.Name) and \
+                    sub.func.id in ("float", "int", "bool") and \
+                    len(sub.args) == 1 and \
+                    isinstance(sub.args[0], (ast.Name, ast.Attribute, ast.Subscript)):
+                msg = (f"`{sub.func.id}(...)` on a device value blocks on the "
+                       "device every step")
+            if msg:
+                out.append(Finding(
+                    path, sub.lineno, sub.col_offset, "JL201",
+                    msg + " inside a batch hot loop — keep metrics on device "
+                    "and fetch once per epoch",
+                ))
+
+
+def _walk_no_defs(body: List[ast.stmt]):
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------- #
+# JL301: thread-shared attributes written outside the lock
+# --------------------------------------------------------------------------- #
+
+
+def run_thread_shared(path: str, tree: ast.Module, out: List[Finding]) -> None:
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        targets: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and \
+                    (dotted(node.func) or "").split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target" and isinstance(kw.value, ast.Attribute) \
+                            and isinstance(kw.value.value, ast.Name) \
+                            and kw.value.value.id == "self":
+                        targets.add(kw.value.attr)
+        if not targets:
+            continue
+        calls = {name: {sub.func.attr for sub in ast.walk(node)
+                        if isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"}
+                 for name, node in methods.items()}
+        producer = _closure(targets, calls)
+        consumer = _closure(set(methods) - targets - {"__init__"}, calls)
+        writes: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        for name, node in methods.items():
+            if name == "__init__":
+                continue
+            for attr, site, locked in _attr_writes(node):
+                writes.setdefault(attr, []).append((name, site, locked))
+        for attr, sites in sorted(writes.items()):
+            in_prod = [s for s in sites if s[0] in producer]
+            in_cons = [s for s in sites if s[0] in consumer]
+            if not (in_prod and in_cons):
+                continue
+            unlocked = [s for s in in_prod + in_cons if not s[2]]
+            if not unlocked:
+                continue
+            _, site, _ = unlocked[0]
+            thread = ", ".join(sorted(targets))
+            out.append(Finding(
+                path, site.lineno, site.col_offset, "JL301",
+                f"`self.{attr}` is written by both the `{thread}` thread and "
+                "consumer methods without holding the lock — guard the write "
+                "or route the value through the queue",
+            ))
+
+
+def _closure(roots: Set[str], calls: Dict[str, Set[str]]) -> Set[str]:
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        for callee in calls.get(frontier.pop(), ()):
+            if callee in calls and callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen
+
+
+def _attr_writes(fn: ast.AST, locked: bool = False):
+    """Yield (attr, node, under_lock) for every ``self.X = ...`` in ``fn``."""
+    def visit(node: ast.AST, locked: bool):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            has_lock = any("lock" in (ast.unparse(i.context_expr).lower())
+                           for i in node.items)
+            for child in node.body:
+                yield from visit(child, locked or has_lock)
+            return
+        tgts: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            tgts = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            tgts = [node.target]
+        for t in tgts:
+            for el in ([t] if not isinstance(t, (ast.Tuple, ast.List)) else t.elts):
+                if isinstance(el, ast.Attribute) and \
+                        isinstance(el.value, ast.Name) and el.value.id == "self":
+                    yield (el.attr, el, locked)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+                and locked is not None and node is not fn:
+            return  # nested defs are not this thread's body
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, locked)
+
+    yield from visit(fn, locked)
+
+
+# --------------------------------------------------------------------------- #
+# driver
+# --------------------------------------------------------------------------- #
+
+
+def run_rules(path: str, tree: ast.Module, index: ProjectIndex) -> List[Finding]:
+    out: List[Finding] = []
+    DonationPass(path, tree, index, out).run()
+    run_scalar_commit(path, tree, out)
+    run_branch_on_tracer(path, tree, out)
+    run_host_sync(path, tree, out)
+    run_thread_shared(path, tree, out)
+    return out

@@ -1,0 +1,189 @@
+"""Runtime contracts for the hazards jaxlint can only partially prove.
+
+Static analysis flags the *patterns*; this module turns the two worst
+outcomes into deterministic failures at run time:
+
+* ``RecompileSentinel`` — a trace-count budget on top of the telemetry
+  ``RecompileMonitor``: every legitimate compile event (task growth, a
+  checkpoint restore) grants ``per_event`` new programs in the group; if the
+  compiled-program count ever exceeds the granted budget, something re-traced
+  silently (the PR 2 leak class).  Emits a ``recompile_budget`` record per
+  check so run logs carry the evidence.
+* donation-aliasing helpers — ``buffer_aliases`` / ``assert_unaliased``
+  compare actual device-buffer pointers against host-buffer pointers (on CPU,
+  ``device_put`` of an aligned array is zero-copy, the PR 3 SIGBUS);
+  ``poison_host_tree`` overwrites restored host buffers so any surviving
+  alias turns into NaN metrics immediately instead of heap corruption later.
+  Enabled by ``--check_donation``.
+
+jax/numpy are imported lazily so ``import analysis`` works in environments
+that only run the linter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set
+
+
+class RecompileBudgetExceeded(AssertionError):
+    """More programs were compiled than (task-growth + restore) events allow."""
+
+
+class DonationAliasError(RuntimeError):
+    """A device array still aliases a restored host buffer."""
+
+
+class RecompileSentinel:
+    """Trace-count budget for one recompile-monitor group.
+
+    ``note_event(kind)`` at every moment a compile is legitimate (head
+    growth, checkpoint restore); ``check(where)`` at stable points (task
+    boundaries).  With ``per_event=1`` the contract is exactly the ISSUE 4
+    acceptance bar: train programs trace at most once per (task-growth,
+    restore) event.
+    """
+
+    def __init__(self, monitor, group: str = "train", per_event: int = 1,
+                 sink=None, enforce: bool = True):
+        self.monitor = monitor
+        self.group = group
+        self.per_event = int(per_event)
+        self.sink = sink  # duck-typed: .log(record_type, **fields) or None
+        self.enforce = enforce
+        self.events: List[Dict[str, Any]] = []
+
+    @property
+    def budget(self) -> int:
+        return self.per_event * len(self.events)
+
+    def note_event(self, kind: str, **attrs) -> None:
+        self.events.append({"kind": kind, **attrs})
+
+    def check(self, where: str, **attrs) -> int:
+        """Compare compiled programs against the granted budget; returns the
+        current program count."""
+        programs = int(self.monitor.total(self.group))
+        ok = programs <= self.budget
+        if self.sink is not None:
+            self.sink.log(
+                "recompile_budget",
+                where=where,
+                group=self.group,
+                budget=self.budget,
+                programs=programs,
+                events=len(self.events),
+                ok=ok,
+                **attrs,
+            )
+        if not ok and self.enforce:
+            kinds = [e["kind"] for e in self.events]
+            raise RecompileBudgetExceeded(
+                f"[{where}] group '{self.group}' compiled {programs} programs "
+                f"but only {self.budget} are budgeted ({len(self.events)} "
+                f"events: {kinds}); some program re-traced silently — look "
+                "for uncommitted scalars or shape-changing host values "
+                "(jaxlint JL101/JL102)"
+            )
+        return programs
+
+
+# --------------------------------------------------------------------------- #
+# Donation aliasing
+# --------------------------------------------------------------------------- #
+
+
+def _leaf_pointers(x) -> Set[int]:
+    """Base addresses of the buffer(s) behind a numpy array or jax.Array."""
+    import numpy as np
+
+    ptrs: Set[int] = set()
+    if isinstance(x, np.ndarray):
+        if x.nbytes:
+            ptrs.add(x.ctypes.data)
+            base = x.base
+            if isinstance(base, np.ndarray) and base.nbytes:
+                ptrs.add(base.ctypes.data)
+        return ptrs
+    shards = getattr(x, "addressable_shards", None)
+    if shards is not None:
+        for s in shards:
+            try:
+                ptrs.add(s.data.unsafe_buffer_pointer())
+            except Exception:  # noqa: BLE001 - non-addressable/deleted shard
+                pass
+    return ptrs
+
+
+def buffer_aliases(a, b) -> bool:
+    """True when the two arrays share at least one underlying buffer."""
+    return bool(_leaf_pointers(a) & _leaf_pointers(b))
+
+
+def assert_unaliased(host_tree, device_tree, where: str = "restore") -> None:
+    """Raise DonationAliasError if any device leaf still points at a host
+    leaf's memory.  Trees are flattened independently: every host pointer is
+    checked against every device pointer (restores reshape/re-nest trees)."""
+    import jax
+
+    host_leaves = jax.tree_util.tree_leaves(host_tree)
+    host_ptrs: Set[int] = set()
+    for leaf in host_leaves:
+        host_ptrs |= _leaf_pointers(leaf)
+    if not host_ptrs:
+        return
+    dev_paths, _ = jax.tree_util.tree_flatten_with_path(device_tree)
+    offenders = []
+    for path, leaf in dev_paths:
+        if _leaf_pointers(leaf) & host_ptrs:
+            offenders.append(jax.tree_util.keystr(path))
+    if offenders:
+        raise DonationAliasError(
+            f"[{where}] {len(offenders)} restored device array(s) alias host "
+            f"checkpoint buffers ({', '.join(offenders[:5])}" +
+            (", ..." if len(offenders) > 5 else "") +
+            "); a donating program would free memory XLA does not own "
+            "(SIGBUS) — re-home with jax.tree_util.tree_map(jnp.copy, ...)"
+        )
+
+
+def poison_host_tree(host_tree, fill: float = float("nan"),
+                     int_fill: int = -(2 ** 30)) -> int:
+    """Overwrite every writable host numpy leaf in-place.
+
+    After a restore has been verified (or as a tripwire when it could not
+    be), poisoning the now-dead host buffers converts any surviving alias
+    into immediate NaN/garbage metrics — a deterministic failure at the
+    point of the bug instead of heap corruption several epochs later.
+    Returns the number of leaves poisoned.
+    """
+    import jax
+    import numpy as np
+
+    count = 0
+    for leaf in jax.tree_util.tree_leaves(host_tree):
+        if not isinstance(leaf, np.ndarray) or not leaf.nbytes:
+            continue
+        if not leaf.flags.writeable:
+            continue
+        if np.issubdtype(leaf.dtype, np.floating):
+            leaf.fill(fill)
+        elif np.issubdtype(leaf.dtype, np.integer):
+            leaf.fill(int_fill)
+        else:
+            continue
+        count += 1
+    return count
+
+
+def install_sentinel(trainer, group: str = "train", per_event: int = 1,
+                     enforce: bool = True) -> Optional[RecompileSentinel]:
+    """Attach a RecompileSentinel to a CilTrainer's telemetry monitor."""
+    monitor = getattr(getattr(trainer, "telemetry", None), "recompiles", None)
+    if monitor is None:
+        return None
+    sentinel = RecompileSentinel(
+        monitor, group=group, per_event=per_event,
+        sink=getattr(trainer, "jsonl", None), enforce=enforce,
+    )
+    trainer.recompile_sentinel = sentinel
+    return sentinel

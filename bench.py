@@ -112,12 +112,18 @@ def bench_step(trainer, Teacher, iters: int):
     import jax
     import jax.numpy as jnp
 
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import (
+        replicated_scalar,
+    )
+
     # Task-1 shape: 50 known classes, 10 new -> the KD step variant.
     trainer.state = trainer._grow_state(trainer.state, 0, 0, 50)
     trainer.teacher = Teacher(
         params=jax.tree_util.tree_map(jnp.copy, trainer.state.params),
         batch_stats=jax.tree_util.tree_map(jnp.copy, trainer.state.batch_stats),
-        known=jnp.int32(50),
+        # Committed, not a bare jnp.int32: an uncommitted scalar re-traces
+        # every program taking it on its second call (jaxlint JL101).
+        known=replicated_scalar(trainer.mesh, 50),
     )
     trainer.state = trainer._grow_state(trainer.state, 1, 50, 10)
 
@@ -194,6 +200,10 @@ def trace_crosscheck(trainer, compiled, steps: int, flops, dt: float) -> dict:
             for _ in range(steps):
                 state, m = compiled(state, trainer.teacher, xd, yd, key, 0.1, 0.5)
             float(np.asarray(m["loss"]))  # host fetch = execution fence
+        # The loop donated trainer.state's buffers into `compiled`; leave the
+        # trainer pointing at the live state or the next caller
+        # (bench_fused_epoch) reads deleted arrays (jaxlint JL001).
+        trainer.state = state
         out = trace_device_step_ms(trace_dir, steps)
         if out.get("trace_step_ms", 0) > 0:
             out["agreement"] = round(dt * 1e3 / out["trace_step_ms"], 3)

@@ -97,6 +97,15 @@ SCHEMA = {
         {"group": str, "task_id": NUM, "epoch": NUM},
         None,
     ),
+    # RecompileSentinel (analysis/runtime.py): trace-budget verdict at every
+    # check point — programs compiled vs the budget granted by task-growth /
+    # restore events.
+    "recompile_budget": (
+        {"where": str, "budget": NUM, "programs": NUM, "events": NUM,
+         "ok": bool},
+        {"group": str, "task_id": NUM},
+        None,
+    ),
     "span": (
         {"name": str, "span_id": NUM, "depth": NUM, "ts": NUM, "dur_s": NUM},
         {"parent": (int, float, type(None))},

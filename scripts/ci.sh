@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# One-shot CI: telemetry-schema lint over the committed evidence logs, a CPU
+# One-shot CI: static analysis first (jaxlint, then ruff/mypy when they are
+# installed), telemetry-schema lint over the committed evidence logs, a CPU
 # prefetch determinism smoke, then the tier-1 test suite (the exact
 # ROADMAP.md command).  Run from anywhere:
 #
@@ -9,16 +10,71 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/3: telemetry schema lint =="
+echo "== stage 1/6: jaxlint (JAX-hazard static analysis) =="
+# Fails on any finding not in analysis/jaxlint_baseline.json.  After fixing
+# or justifying findings, refresh with: python scripts/jaxlint.py --write-baseline
+python scripts/jaxlint.py || exit 1
+
+echo "== stage 2/6: ruff + mypy (skipped when not installed) =="
+# Configured in pyproject.toml; the container does not bake these in, so the
+# stage gates on availability instead of failing the whole run.
+if command -v ruff >/dev/null 2>&1; then
+  ruff check . || exit 1
+else
+  echo "ruff not installed; skipping"
+fi
+if command -v mypy >/dev/null 2>&1; then
+  mypy || exit 1
+else
+  echo "mypy not installed; skipping"
+fi
+
+echo "== stage 3/6: telemetry schema lint =="
 python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
 
-echo "== stage 2/3: CPU prefetch smoke (depth 2 ≡ depth 0) =="
+echo "== stage 4/6: CPU prefetch smoke (depth 2 ≡ depth 0) =="
 # Two-task synthetic run on the per-batch step path at --prefetch_depth 2;
 # its accuracy matrix must match a depth-0 run exactly (the asynchronous
 # input pipeline's determinism guarantee, data/prefetch.py).
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/prefetch_smoke.py || exit 1
 
-echo "== stage 3/3: tier-1 tests =="
+echo "== stage 5/6: jaxlint self-test fixtures =="
+# The linter must still *find* the hazards it exists for (incl. the PR 3
+# restore-aliasing regression); covered by tests/test_jaxlint.py in tier-1,
+# but a broken linter that silently passes everything would also pass stage 1,
+# so assert non-zero exit on the known-bad fixture tree here too.
+python - <<'PY' || exit 1
+import pathlib, subprocess, sys, tempfile
+
+BAD = '''
+import pickle
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+def resume(path, state, batch):
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    params = jax.device_put(payload["params"])
+    state = state.replace(params=params)
+    state = step(state, batch)
+    return state
+'''
+with tempfile.TemporaryDirectory() as d:
+    p = pathlib.Path(d, "bad.py")
+    p.write_text(BAD)
+    proc = subprocess.run(
+        [sys.executable, "scripts/jaxlint.py", "--baseline", "none", str(p)],
+        capture_output=True, text=True)
+    if proc.returncode == 0 or "JL002" not in proc.stdout:
+        print(proc.stdout + proc.stderr)
+        print("jaxlint failed to flag the restore-aliasing fixture")
+        sys.exit(1)
+print("jaxlint flags the restore-aliasing fixture: OK")
+PY
+
+echo "== stage 6/6: tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""jaxlint CLI — the CI gate over the repo's JAX-hazard rules.
+
+Usage:
+    python scripts/jaxlint.py                     # lint the default targets
+    python scripts/jaxlint.py path1 path2 ...     # lint specific files/dirs
+    python scripts/jaxlint.py --write-baseline    # accept current findings
+    python scripts/jaxlint.py --baseline none     # ignore the baseline
+    python scripts/jaxlint.py --list-rules        # print the rule catalog
+
+Exit codes: 0 = no findings outside the baseline; 1 = new findings (printed
+as ``path:line:col: RULE message``); 2 = usage error.  Stale baseline
+entries (fixed findings still listed) are warned about but do not fail —
+refresh with ``--write-baseline``.
+
+Stdlib-only: this never imports jax, so the lint stage runs anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from analysis import (  # noqa: E402 - needs the sys.path bootstrap above
+    DEFAULT_TARGETS,
+    Baseline,
+    RULES,
+    lint_paths,
+)
+from analysis.linter import DEFAULT_BASELINE  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="jaxlint", description=__doc__)
+    parser.add_argument("paths", nargs="*", help="files/dirs relative to the "
+                        "repo root (default: the committed lint scope)")
+    parser.add_argument("--root", default=_REPO_ROOT,
+                        help="project root findings are reported relative to")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON path, or 'none' to disable")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings "
+                        "(keeps reasons of entries that still match)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, summary in sorted(RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    targets = args.paths or list(DEFAULT_TARGETS)
+    findings = lint_paths(targets, root=root)
+
+    baseline_path = None if args.baseline.lower() == "none" else (
+        args.baseline if os.path.isabs(args.baseline)
+        else os.path.join(root, args.baseline))
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+
+    if args.write_baseline:
+        if not baseline_path:
+            print("jaxlint: --write-baseline needs a baseline path", file=sys.stderr)
+            return 2
+        baseline.write(baseline_path, findings)
+        print(f"jaxlint: baseline rewritten with {len(findings)} finding(s) "
+              f"-> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    new, known, stale = baseline.split(findings)
+    for f in new:
+        print(f.render())
+    if known:
+        print(f"jaxlint: {len(known)} baselined finding(s) suppressed "
+              f"(see {os.path.relpath(baseline_path, root)})")
+    for e in stale:
+        print(f"jaxlint: stale baseline entry (fixed? refresh with "
+              f"--write-baseline): {e['path']}:{e['line']} {e['rule']}")
+    if new:
+        print(f"jaxlint: {len(new)} new finding(s) in {len(set(f.path for f in new))} "
+              "file(s); fix them, add '# jaxlint: disable=<rule>' with a reason, "
+              "or baseline with --write-baseline")
+        return 1
+    print(f"jaxlint: clean ({len(findings)} finding(s) total, "
+          f"{len(known)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2,6 +2,13 @@
 """Render one run's full observability story from its telemetry output.
 
 Usage: python scripts/report_run.py <run.jsonl> [spans.jsonl]
+       python scripts/report_run.py <A/run.jsonl> <B/run.jsonl>   # diff mode
+
+When the second file is itself a run log (it has task/epoch records rather
+than spans), the report becomes a side-by-side diff of the two runs:
+per-task accuracy deltas, final forgetting/BWT deltas, per-task stall
+accounting deltas, and recompile-count deltas — the "did my change help"
+question answered from the committed logs alone (ROADMAP PR 2 follow-up).
 
 Consumes the unified-sink JSONL a ``--telemetry_dir`` run produces (and the
 span file next to it, auto-discovered when not given):
@@ -186,7 +193,134 @@ def render_spans(spans_path: str):
         print()
 
 
-def main(run_path: str, spans_path: str | None = None):
+def _is_run_log(by_type) -> bool:
+    return bool(by_type["task"] or by_type["epoch"] or by_type["run"]
+                or by_type["final"])
+
+
+def _final_matrix(tasks):
+    """The complete accuracy matrix of a run, or None (partial log)."""
+    rows = {t["task_id"]: t.get("acc_per_task") for t in tasks}
+    if not rows or any(r is None for r in rows.values()):
+        return None
+    T = max(rows) + 1
+    if sorted(rows) != list(range(T)) or any(len(rows[t]) != t + 1 for t in rows):
+        return None
+    return [rows[t] for t in sorted(rows)]
+
+
+def _task_stalls(epochs):
+    """task_id -> (host_s, device_s, wall_s) summed over its epochs."""
+    out = defaultdict(lambda: [0.0, 0.0, 0.0])
+    for e in epochs:
+        if "host_s" not in e or "device_s" not in e:
+            continue
+        acc = out[e.get("task_id", "?")]
+        acc[0] += e["host_s"]
+        acc[1] += e["device_s"]
+        acc[2] += e.get("epoch_s", e["host_s"] + e["device_s"])
+    return out
+
+
+def _fmt_delta(a, b, fmt="{:+.2f}"):
+    if a is None or b is None:
+        return "—"
+    return fmt.format(b - a)
+
+
+def diff_runs(path_a: str, path_b: str):
+    """Side-by-side deltas of two run logs (B relative to A)."""
+    a, b = load_records(path_a), load_records(path_b)
+    print(f"# run diff — A: {path_a}  vs  B: {path_b}\n")
+
+    runs_a, runs_b = a["run"], b["run"]
+    if runs_a and runs_b:
+        ca = {k: v for k, v in runs_a[-1].items() if k not in ("type", "ts")}
+        cb = {k: v for k, v in runs_b[-1].items() if k not in ("type", "ts")}
+        changed = {k for k in set(ca) | set(cb) if ca.get(k) != cb.get(k)}
+        if changed:
+            print("config differences:\n")
+            print("| key | A | B |")
+            print("|---|---|---|")
+            for k in sorted(changed):
+                print(f"| {k} | {ca.get(k, '—')} | {cb.get(k, '—')} |")
+            print()
+        else:
+            print("config: identical\n")
+
+    ta = {t["task_id"]: t for t in a["task"]}
+    tb = {t["task_id"]: t for t in b["task"]}
+    stalls_a, stalls_b = _task_stalls(a["epoch"]), _task_stalls(b["epoch"])
+    if ta or tb:
+        print("per-task cumulative top-1 and input stall (Δ = B − A):\n")
+        print("| task | A acc1 | B acc1 | Δ acc1 | A stall | B stall | Δ stall |")
+        print("|---|---|---|---|---|---|---|")
+        for tid in sorted(set(ta) | set(tb)):
+            ra, rb = ta.get(tid), tb.get(tid)
+            acc_a = ra["acc1"] if ra else None
+            acc_b = rb["acc1"] if rb else None
+            sa = stalls_a.get(tid)
+            sb = stalls_b.get(tid)
+            fa = sa[0] / max(sa[2], 1e-9) if sa else None
+            fb = sb[0] / max(sb[2], 1e-9) if sb else None
+            cells = [
+                str(tid),
+                f"{acc_a:.2f}" if acc_a is not None else "—",
+                f"{acc_b:.2f}" if acc_b is not None else "—",
+                _fmt_delta(acc_a, acc_b),
+                f"{fa:.3f}" if fa is not None else "—",
+                f"{fb:.3f}" if fb is not None else "—",
+                _fmt_delta(fa, fb, "{:+.3f}"),
+            ]
+            print("| " + " | ".join(cells) + " |")
+        print()
+
+    acc_a = [ta[t]["acc1"] for t in sorted(ta)]
+    acc_b = [tb[t]["acc1"] for t in sorted(tb)]
+    if acc_a and acc_b:
+        avg_a = average_incremental_accuracy(acc_a)
+        avg_b = average_incremental_accuracy(acc_b)
+        print(
+            f"avg incremental top-1: A {avg_a:.3f}%  B {avg_b:.3f}%  "
+            f"(Δ {avg_b - avg_a:+.3f})\n"
+        )
+
+    ma, mb = _final_matrix(a["task"]), _final_matrix(b["task"])
+    if ma and mb:
+        fga, fgb = per_task_forgetting(ma), per_task_forgetting(mb)
+        print("final forgetting per val slice (Δ = B − A, negative = less "
+              "forgetting):\n")
+        print("| slice | A | B | Δ |")
+        print("|---|---|---|---|")
+        for j in range(max(len(fga), len(fgb))):
+            va = fga[j] if j < len(fga) else None
+            vb = fgb[j] if j < len(fgb) else None
+            ca = f"{va:+.2f}" if va is not None else "—"
+            cb = f"{vb:+.2f}" if vb is not None else "—"
+            print(f"| j={j} | {ca} | {cb} | {_fmt_delta(va, vb)} |")
+        bwt_a, bwt_b = backward_transfer(ma), backward_transfer(mb)
+        print(f"\nBWT: A {bwt_a:+.3f}  B {bwt_b:+.3f}  "
+              f"(Δ {bwt_b - bwt_a:+.3f})\n")
+    elif ma or mb:
+        print("(forgetting/BWT diff skipped: one run has a partial matrix)\n")
+
+    rc_a = sum(r.get("new_programs", 0) for r in a["recompile"])
+    rc_b = sum(r.get("new_programs", 0) for r in b["recompile"])
+    warn_a, warn_b = len(a["recompile_warning"]), len(b["recompile_warning"])
+    print(
+        f"recompiles: A {rc_a} program(s) ({warn_a} unexpected)  "
+        f"B {rc_b} program(s) ({warn_b} unexpected)  (Δ {rc_b - rc_a:+d})"
+    )
+
+
+def main(run_path: str, second_path: str | None = None):
+    if second_path and _is_run_log(load_records(second_path)):
+        # Two run logs -> side-by-side diff.  A spans file has only span
+        # records, so the old `report_run.py run.jsonl spans.jsonl` form
+        # still renders the single-run report below.
+        diff_runs(run_path, second_path)
+        return
+    spans_path = second_path
     by_type = load_records(run_path)
     print(f"# run report — {run_path}\n")
     if by_type["run"]:
@@ -220,5 +354,7 @@ def main(run_path: str, spans_path: str | None = None):
 
 if __name__ == "__main__":
     if len(sys.argv) < 2:
-        sys.exit("usage: report_run.py <run.jsonl> [spans.jsonl]")
+        sys.exit(
+            "usage: report_run.py <run.jsonl> [spans.jsonl | other_run.jsonl]"
+        )
     main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)

@@ -1,0 +1,524 @@
+"""jaxlint: per-rule positive/negative fixtures, suppressions, baseline
+behaviour, the CLI exit-code contract, and the runtime-contract half
+(RecompileSentinel budget math, buffer-alias detection on real CPU arrays).
+
+The fixture snippets are *strings written to tmp_path* — they are analyzed
+by the stdlib-only AST pass, never imported or executed, so they reference
+names (jax, state, ...) freely and deliberately contain the hazards the
+linter exists for.  The analysis package itself must import without jax.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from analysis import Baseline, lint_paths
+from analysis.findings import Finding, is_suppressed, parse_suppressions
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def run_lint(tmp_path, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return lint_paths([str(p)], root=str(tmp_path))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------------------------- #
+# JL001 — read after donate
+# --------------------------------------------------------------------------- #
+
+
+def test_jl001_read_after_donate(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            new_state = step(state, batch)
+            loss = state.params  # read of donated buffer
+            return new_state, loss
+        """)
+    assert rules_of(findings) == ["JL001"]
+    (f,) = findings
+    assert f.line == 8 and "donated" in f.message
+
+
+def test_jl001_rebind_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            state = step(state, batch)  # rebound: the old buffer is gone
+            return state.params
+        """)
+    assert findings == []
+
+
+def test_jl001_escape_of_donated_attribute(tmp_path):
+    # The bench.py trace_crosscheck bug: self.state donated into a profiled
+    # call and never rebound before the function returns.
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def profile(trainer, batch):
+            step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            state = trainer.state
+            state = step(state, batch)
+            out = step(trainer.state, batch)  # donates trainer.state
+            return out
+        """)
+    assert "JL001" in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# JL002 — restored host buffer into donating program (the PR 3 regression)
+# --------------------------------------------------------------------------- #
+
+PR3_REGRESSION = """
+    import pickle
+    import jax
+    import jax.numpy as jnp
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import shard_params
+
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+    def load_task_checkpoint(trainer, path):
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        params = shard_params(trainer.mesh, payload["params"])
+        trainer.state = trainer.state.replace(params=params)
+        return True
+    """
+
+
+def test_jl002_pr3_restore_aliasing_regression(tmp_path):
+    """The exact PR 3 shape: pickle.load -> shard_params -> state.replace
+    without jnp.copy.  Must flag with the right file, line and rule id."""
+    p = tmp_path / "ckpt.py"
+    p.write_text(textwrap.dedent(PR3_REGRESSION))
+    findings = lint_paths([str(p)], root=str(tmp_path))
+    assert [f.rule for f in findings] == ["JL002"]
+    (f,) = findings
+    assert f.path == "ckpt.py"
+    assert f.line == 13  # the state.replace(params=params) line
+    assert "jnp.copy" in f.message
+    assert f.render().startswith("ckpt.py:13:")
+
+
+def test_jl002_copy_sanitizes(tmp_path):
+    findings = run_lint(tmp_path, """
+        import pickle
+        import jax
+        import jax.numpy as jnp
+
+        def load(trainer, path):
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            params = jax.tree_util.tree_map(jnp.copy, payload["params"])
+            trainer.state = trainer.state.replace(params=params)
+        """)
+    assert findings == []
+
+
+def test_jl002_orbax_restore_tainted(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def load(trainer, ckptr, path, template):
+            restored = ckptr.restore(path, template)
+            trainer.state = trainer.state.replace(params=restored["params"])
+        """)
+    assert rules_of(findings) == ["JL002"]
+
+
+# --------------------------------------------------------------------------- #
+# JL101 — uncommitted scalars
+# --------------------------------------------------------------------------- #
+
+
+def test_jl101_uncommitted_scalar(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax.numpy as jnp
+
+        def grow(trainer, known):
+            trainer.state = trainer.state.replace(num_active=jnp.int32(known))
+        """)
+    assert rules_of(findings) == ["JL101"]
+
+
+def test_jl101_replicated_scalar_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import replicated_scalar
+
+        def grow(trainer, known):
+            trainer.state = trainer.state.replace(
+                num_active=replicated_scalar(trainer.mesh, known)
+            )
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL102 — branch on tracer
+# --------------------------------------------------------------------------- #
+
+
+def test_jl102_branch_on_tracer(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def step(state, batch):
+            if batch["y"] > 0:
+                return state
+            return state
+
+        step = jax.jit(step)
+        """)
+    assert rules_of(findings) == ["JL102"]
+
+
+def test_jl102_static_argnums_excluded(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def step(state, use_teacher):
+            if use_teacher:
+                return state
+            return state
+
+        step = jax.jit(step, static_argnums=(1,))
+        """)
+    assert findings == []
+
+
+def test_jl102_is_none_test_allowed(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def step(state, teacher):
+            if teacher is None:
+                return state
+            return state
+
+        step = jax.jit(step)
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL201 — host sync in hot loop
+# --------------------------------------------------------------------------- #
+
+
+def test_jl201_item_in_batch_loop(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def run_epoch(step, state, batches):
+            total = 0.0
+            for batch in batches:
+                state, loss = step(state, batch)
+                total += loss.item()  # per-step device sync
+            return state, total
+        """)
+    assert rules_of(findings) == ["JL201"]
+
+
+def test_jl201_sync_after_loop_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def run_epoch(step, state, batches):
+            losses = []
+            for batch in batches:
+                state, loss = step(state, batch)
+                losses.append(loss)
+            return state, [x.item() for x in losses]
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL301 — thread-shared state
+# --------------------------------------------------------------------------- #
+
+
+def test_jl301_unlocked_shared_attribute(tmp_path):
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._step = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    self._step += 1  # producer write, no lock
+
+            def read(self):
+                return self._step  # consumer write elsewhere
+
+            def update(self, n):
+                self._step = n
+        """)
+    assert rules_of(findings) == ["JL301"]
+
+
+def test_jl301_locked_writes_are_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._step = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self._step += 1
+
+            def update(self, n):
+                with self._lock:
+                    self._step = n
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# suppressions / baseline / JL000
+# --------------------------------------------------------------------------- #
+
+
+def test_suppression_comment(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            new_state = step(state, batch)
+            loss = state.params  # jaxlint: disable=JL001 -- test rig
+            return new_state, loss
+        """)
+    assert findings == []
+
+
+def test_suppression_parsing():
+    sup = parse_suppressions(
+        "x = 1  # jaxlint: disable=JL001, JL101\ny = 2\n"
+    )
+    assert sup == {1: {"JL001", "JL101"}}
+    f = Finding(path="p.py", line=1, col=0, rule="JL001", message="m")
+    assert is_suppressed(f, sup)
+    assert not is_suppressed(
+        Finding(path="p.py", line=2, col=0, rule="JL001", message="m"), sup
+    )
+
+
+def test_jl000_syntax_error(tmp_path):
+    findings = run_lint(tmp_path, "def broken(:\n")
+    assert rules_of(findings) == ["JL000"]
+
+
+def test_baseline_split_and_stale(tmp_path):
+    f1 = Finding(path="a.py", line=3, col=0, rule="JL001", message="m1")
+    f2 = Finding(path="b.py", line=7, col=0, rule="JL201", message="m2")
+    path = tmp_path / "base.json"
+    Baseline().write(str(path), [f1])
+    base = Baseline.load(str(path))
+    new, known, stale = base.split([f1, f2])
+    assert [f.rule for f in new] == ["JL201"]
+    assert [f.rule for f in known] == ["JL001"]
+    assert stale == []
+    # f1 fixed -> its entry goes stale
+    new, known, stale = base.split([f2])
+    assert [f.rule for f in new] == ["JL201"]
+    assert known == [] and len(stale) == 1
+
+
+def test_baseline_write_preserves_reasons(tmp_path):
+    f1 = Finding(path="a.py", line=3, col=0, rule="JL001", message="m1")
+    path = tmp_path / "base.json"
+    Baseline().write(str(path), [f1])
+    data = json.loads(path.read_text())
+    data["findings"][0]["reason"] = "justified because reasons"
+    path.write_text(json.dumps(data))
+    Baseline.load(str(path)).write(str(path), [f1])  # rewrite keeps the reason
+    data = json.loads(path.read_text())
+    assert data["findings"][0]["reason"] == "justified because reasons"
+
+
+# --------------------------------------------------------------------------- #
+# CLI exit codes
+# --------------------------------------------------------------------------- #
+
+
+def test_cli_nonzero_on_fixture_dir(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(PR3_REGRESSION))
+    proc = subprocess.run(
+        [sys.executable, f"{REPO}/scripts/jaxlint.py",
+         "--baseline", "none", str(bad)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "JL002" in proc.stdout
+
+
+def test_cli_zero_on_repo():
+    """Dogfood gate: the repo itself lints clean against its committed
+    baseline — every finding is fixed or justified."""
+    proc = subprocess.run(
+        [sys.executable, f"{REPO}/scripts/jaxlint.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, f"{REPO}/scripts/jaxlint.py", "--list-rules"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+    for rule in ("JL001", "JL002", "JL101", "JL102", "JL201", "JL301"):
+        assert rule in proc.stdout
+
+
+# --------------------------------------------------------------------------- #
+# runtime contracts
+# --------------------------------------------------------------------------- #
+
+
+class FakeMonitor:
+    def __init__(self):
+        self.programs = 0
+
+    def total(self, group):
+        return self.programs
+
+
+class FakeSink:
+    def __init__(self):
+        self.records = []
+
+    def log(self, rtype, **fields):
+        self.records.append({"type": rtype, **fields})
+
+
+def test_sentinel_budget_math():
+    from analysis.runtime import RecompileBudgetExceeded, RecompileSentinel
+
+    mon, sink = FakeMonitor(), FakeSink()
+    s = RecompileSentinel(mon, group="train", per_event=1, sink=sink)
+    assert s.budget == 0
+    s.note_event("task_growth", task_id=0)
+    s.note_event("task_growth", task_id=1)
+    mon.programs = 2
+    assert s.check(where="task1", task_id=1) == 2
+    rec = sink.records[-1]
+    assert rec["type"] == "recompile_budget"
+    assert rec["budget"] == 2 and rec["programs"] == 2 and rec["ok"] is True
+    # one silent re-trace over budget -> raise
+    mon.programs = 3
+    with pytest.raises(RecompileBudgetExceeded, match="re-traced silently"):
+        s.check(where="task1", task_id=1)
+    assert sink.records[-1]["ok"] is False
+
+
+def test_sentinel_restore_event_and_enforce_off():
+    from analysis.runtime import RecompileSentinel
+
+    mon, sink = FakeMonitor(), FakeSink()
+    s = RecompileSentinel(mon, per_event=2, sink=sink, enforce=False)
+    s.note_event("restore", task_id=0)
+    mon.programs = 5  # over budget (2), but enforce=False only records it
+    s.check(where="resume")
+    assert sink.records[-1]["ok"] is False and sink.records[-1]["budget"] == 2
+
+
+def test_buffer_alias_detection():
+    """The PR 3 mechanism, reproduced: on CPU, device_put of an aligned host
+    array is zero-copy (the jax.Array aliases the numpy buffer), and
+    jnp.copy re-homes it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analysis.runtime import (
+        DonationAliasError,
+        assert_unaliased,
+        buffer_aliases,
+        poison_host_tree,
+    )
+
+    # XLA's CPU zero-copy path requires 64-byte alignment; numpy's allocator
+    # only guarantees 16, so carve an aligned view out of a byte buffer to
+    # make the aliasing deterministic.
+    nbytes = 256 * 256 * 4
+    raw = np.zeros(nbytes + 64, dtype=np.uint8)
+    off = (-raw.ctypes.data) % 64
+    host = raw[off:off + nbytes].view(np.float32).reshape(256, 256)
+    host[...] = 1.0
+    aliased = jax.device_put(host)
+    if not buffer_aliases(host, aliased):
+        pytest.skip("this CPU backend copies on device_put")
+    with pytest.raises(DonationAliasError, match="alias"):
+        assert_unaliased({"w": host}, {"w": aliased}, where="test")
+
+    rehomed = jnp.copy(aliased)
+    assert not buffer_aliases(host, rehomed)
+    assert_unaliased({"w": host}, {"w": rehomed}, where="test")
+
+    # Poisoning the host tree reaches the aliased device view, not the copy.
+    assert poison_host_tree({"w": host}) == 1
+    assert bool(jnp.isnan(aliased).all())
+    assert not bool(jnp.isnan(rehomed).any())
+
+
+def test_poison_host_tree_dtypes():
+    import numpy as np
+
+    from analysis.runtime import poison_host_tree
+
+    tree = {
+        "f": np.ones(4, dtype=np.float32),
+        "i": np.ones(4, dtype=np.int32),
+        "b": np.ones(4, dtype=bool),  # left alone
+    }
+    ro = np.ones(4, dtype=np.float32)
+    ro.flags.writeable = False
+    tree["ro"] = ro
+    assert poison_host_tree(tree) == 2
+    assert np.isnan(tree["f"]).all()
+    assert (tree["i"] == -(2 ** 30)).all()
+    assert (tree["b"] == 1).all()
+    assert (tree["ro"] == 1).all()
+
+
+def test_analysis_package_imports_without_jax():
+    """The CI lint stage must run in jax-free environments: importing the
+    package (not analysis.runtime) may not pull in jax."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.modules['jax'] = None; "
+         "import analysis; print(len(analysis.RULES))"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert int(proc.stdout.strip()) >= 7
