@@ -147,6 +147,15 @@ class CilConfig:
     ckpt_dir: Optional[str] = None
     ckpt_backend: str = "pickle"  # "orbax": sharded tensorstore writes/restores
     resume: bool = False
+    epoch_ckpt_every: int = 0  # E > 0: also checkpoint mid-task every E epochs
+    # (task_{t}_epoch_{e}.ckpt, pickle; includes momentum/teacher/memory so a
+    # kill mid-task resumes at the last epoch boundary bit-for-bit); 0 = task
+    # boundaries only.  Epoch checkpoints are removed once the task completes.
+
+    # Fault injection (faults/ package; see README "Fault tolerance")
+    fault_spec: Optional[str] = None  # e.g. "kill@task1.epoch3,corrupt_ckpt@task2"
+    fault_state: Optional[str] = None  # fired-clause ledger path; defaults to
+    # <ckpt_dir>/fault_ledger.jsonl so a supervised relaunch does not re-fire
 
     # Runtime contracts (analysis/runtime.py; see README "Static analysis")
     recompile_budget: bool = False  # RecompileSentinel: train programs may
@@ -264,6 +273,21 @@ def get_args_parser() -> argparse.ArgumentParser:
                    "shards via tensorstore; restore places arrays directly "
                    "onto the mesh sharding (no host gather)")
     p.add_argument("--resume", action="store_true", default=False)
+    p.add_argument("--epoch_ckpt_every", default=d.epoch_ckpt_every, type=int,
+                   help="also write mid-task epoch checkpoints every E epochs "
+                   "(task_{t}_epoch_{e}.ckpt) so --resume restarts at the "
+                   "last epoch boundary instead of the task boundary; 0 = "
+                   "task boundaries only")
+    p.add_argument("--fault_spec", default=None, type=str,
+                   help="deterministic fault injection plan, e.g. "
+                   "'kill@task1.epoch3,corrupt_ckpt@task2' "
+                   "(faults/injector.py; coordinates: 0-based task, 1-based "
+                   "epoch/step; each clause fires once at the END of the "
+                   "named unit)")
+    p.add_argument("--fault_state", default=None, type=str,
+                   help="fired-fault ledger path (defaults to "
+                   "<ckpt_dir>/fault_ledger.jsonl); a relaunched process "
+                   "skips clauses already recorded here")
     p.add_argument("--recompile_budget", action="store_true", default=False,
                    help="enforce the RecompileSentinel trace budget: train "
                    "programs may compile at most once per task growth or "
@@ -368,6 +392,9 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         ckpt_dir=args.ckpt_dir,
         ckpt_backend=args.ckpt_backend,
         resume=args.resume,
+        epoch_ckpt_every=args.epoch_ckpt_every,
+        fault_spec=args.fault_spec,
+        fault_state=args.fault_state,
         recompile_budget=args.recompile_budget,
         check_donation=args.check_donation,
         profile_dir=args.profile_dir,

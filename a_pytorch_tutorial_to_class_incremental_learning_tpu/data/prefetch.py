@@ -17,9 +17,18 @@ Guarantees:
 * **Byte-identical streams.**  The producer thread iterates the very same
   synchronous generator the caller would have iterated (same seeds, same
   order); threading changes *when* a batch is produced, never *what*.
-* **Exception propagation.**  An exception anywhere in production (source
-  generator or placement) is caught on the producer thread, enqueued, and
-  re-raised in the consumer — after the thread has been shut down cleanly.
+* **Exception propagation.**  An exception in the *source generator* is
+  caught on the producer thread, enqueued, and re-raised in the consumer —
+  after the thread has been shut down cleanly.
+* **Graceful degradation.**  An exception in *placement* (decode /
+  ``device_put`` — the part that can die transiently: OOM spike, injected
+  ``producer_die`` fault) does not abort the epoch: the producer hands the
+  un-placed host batch back through the queue and exits, the consumer joins
+  it, invokes ``on_degrade`` (telemetry hook), retries that batch's
+  placement inline, and continues the rest of the stream on the synchronous
+  depth-0 path.  Queue FIFO order guarantees the stream stays
+  byte-identical; only a placement failure that *also* fails the inline
+  retry (deterministic, not transient) is re-raised.
 * **Clean shutdown.**  ``close()`` (idempotent; also invoked on exhaustion,
   on error, and by the context-manager exit) signals the producer, drains
   the ring buffer, and joins the thread — no leaked threads on early loop
@@ -47,7 +56,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
-_BATCH, _DONE, _ERROR = "batch", "done", "error"
+_BATCH, _DONE, _ERROR, _DEGRADE = "batch", "done", "error", "degrade"
 
 
 class DevicePrefetcher:
@@ -68,11 +77,14 @@ class DevicePrefetcher:
         depth: int = 0,
         clock=None,
         name: str = "prefetch",
+        on_degrade: Optional[Callable] = None,
     ):
         self._source = iter(source)
         self._place = place if place is not None else (lambda batch: batch)
         self.depth = max(0, int(depth))
         self._clock = clock
+        self._on_degrade = on_degrade
+        self._degraded = False
         self._fill_sum = 0
         self._gets = 0
         self._closed = False
@@ -92,16 +104,29 @@ class DevicePrefetcher:
     # ------------------------------------------------------------------ #
 
     def _produce(self) -> None:
-        try:
-            for host_batch in self._source:
+        while True:
+            try:
+                host_batch = next(self._source)
+            except StopIteration:
+                self._enqueue((_DONE, None))
+                return
+            except BaseException as e:  # noqa: BLE001 — must cross the thread
+                # A broken *source* is unrecoverable (its position is lost);
+                # re-raised on the consumer.
+                self._enqueue((_ERROR, e))
+                return
+            try:
                 placed = (_BATCH, self._place(host_batch))
-                del host_batch
-                if not self._enqueue(placed):
-                    return  # close() raced us; drop the reference and exit
-                del placed  # donation safety: no trailing reference
-            self._enqueue((_DONE, None))
-        except BaseException as e:  # noqa: BLE001 — must cross the thread
-            self._enqueue((_ERROR, e))
+            except BaseException as e:  # noqa: BLE001 — must cross the thread
+                # Placement died, but the host batch is intact: hand it back
+                # so the consumer can degrade to the synchronous path without
+                # losing (or reordering) a single batch.
+                self._enqueue((_DEGRADE, (e, host_batch)))
+                return
+            del host_batch
+            if not self._enqueue(placed):
+                return  # close() raced us; drop the reference and exit
+            del placed  # donation safety: no trailing reference
 
     def _enqueue(self, item) -> bool:
         """Bounded put that stays responsive to ``close()``."""
@@ -123,9 +148,12 @@ class DevicePrefetcher:
     def __next__(self):
         if self._exhausted or self._closed:
             raise StopIteration
-        if self.depth == 0:
+        if self.depth == 0 or self._degraded:
             # Synchronous passthrough: production (source + placement) runs
-            # inline and its full cost is host/input-pipeline time.
+            # inline and its full cost is host/input-pipeline time.  Also the
+            # post-degradation path: the dead producer left the shared source
+            # iterator exactly one batch past the handback, so continuing it
+            # here preserves the byte-identical stream.
             t0 = time.perf_counter()
             try:
                 try:
@@ -149,11 +177,44 @@ class DevicePrefetcher:
             self._clock.add_host(time.perf_counter() - t0)
         if tag == _BATCH:
             return payload
+        if tag == _DEGRADE:
+            exc, host_batch = payload
+            self._note_degraded(exc)
+            t0 = time.perf_counter()
+            try:
+                placed = self._place(host_batch)
+            except BaseException:
+                # The retry failing too means the placement failure is
+                # deterministic, not transient — degrading cannot help.
+                self._exhausted = True
+                self.close()
+                raise
+            finally:
+                if self._clock is not None:
+                    self._clock.add_host(time.perf_counter() - t0)
+            return placed
         self._exhausted = True
         self.close()
         if tag == _ERROR:
             raise payload
         raise StopIteration
+
+    def _note_degraded(self, exc: BaseException) -> None:
+        """Producer death observed: join the (already exiting) thread, flip
+        to the synchronous path for the rest of the stream, and tell the
+        owner via ``on_degrade`` (the telemetry hook that emits the
+        ``prefetch_degraded`` record)."""
+        self._degraded = True
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        print(f"| prefetch producer died ({exc!r}); degrading to synchronous")
+        if self._on_degrade is not None:
+            try:
+                self._on_degrade(exc)
+            except Exception as cb_err:
+                # The hook is observability; it must not mask the recovery.
+                print(f"| prefetch on_degrade callback failed: {cb_err!r}")
 
     # ------------------------------------------------------------------ #
     # Lifecycle
@@ -202,8 +263,8 @@ class DevicePrefetcher:
     def __del__(self):  # pragma: no cover — belt and braces
         try:
             self.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001  # jaxlint: disable=JL302
+            pass  # interpreter teardown: nothing left to report to
 
     # ------------------------------------------------------------------ #
     # Introspection
@@ -219,4 +280,5 @@ class DevicePrefetcher:
         return {
             "prefetch_depth": self.depth,
             "prefetch_depth_occupancy": round(self.occupancy(), 4),
+            "prefetch_degraded": int(self._degraded),
         }

@@ -103,6 +103,20 @@ class CilTrainer:
             heartbeat_interval_s=config.heartbeat_interval_s,
             sink=self.jsonl,
         )
+        # Deterministic fault injection (--fault_spec; faults/injector.py).
+        # None when unset, so every hot-path site pays one identity check.
+        # The ledger defaults next to the checkpoints: a supervised relaunch
+        # of a killed run parses the same spec but finds the clause spent.
+        self.faults = None
+        if config.fault_spec:
+            from faults import injector_from
+
+            ledger = config.fault_state
+            if ledger is None and config.ckpt_dir:
+                ledger = os.path.join(config.ckpt_dir, "fault_ledger.jsonl")
+            self.faults = injector_from(
+                config.fault_spec, ledger_path=ledger, sink=self.jsonl
+            )
         with self.telemetry.span("build_scenario"):
             self.scenario_train, self.nb_classes = build_scenario(
                 config, train=True
@@ -293,6 +307,8 @@ class CilTrainer:
         self.acc_matrix: List[List[float]] = []  # row t = acc_per_task after task t
         self.known = 0
         self.start_task = 0
+        self.start_epoch = 0  # > 0 only after an epoch-checkpoint restore
+        self.resumed_from = None  # {"path", "kind": "task"|"epoch"} when resumed
         if config.resume and config.ckpt_dir:
             from ..utils.checkpoint import load_task_checkpoint
 
@@ -300,8 +316,18 @@ class CilTrainer:
         if config.resume:
             # Segment marker: consumers can drop records before the last
             # resume whose task_id >= start_task (a crash between a task's
-            # records and its checkpoint replays that task).
-            self.jsonl.log("resume", start_task=self.start_task)
+            # records and its checkpoint replays that task; with epoch
+            # checkpoints the replay window shrinks to epochs > start_epoch).
+            extra = {}
+            if self.resumed_from is not None:
+                extra = {"path": self.resumed_from["path"],
+                         "kind": self.resumed_from["kind"]}
+            self.jsonl.log(
+                "resume",
+                start_task=self.start_task,
+                start_epoch=self.start_epoch,
+                **extra,
+            )
 
     # ------------------------------------------------------------------ #
     # Batch placement
@@ -358,13 +384,23 @@ class CilTrainer:
                     with tel.span("rehearsal_inject", task=task_id):
                         task_train.add_samples(*self.memory.get())
 
-                # Head growth before training (reference template.py:241).
-                with tel.span("head_grow", task=task_id):
-                    self.state = self._grow_state(
-                        self.state, task_id, self.known, nb_new
-                    )
+                # Mid-task (epoch-checkpoint) resume: the restored params are
+                # already post-growth for this task — re-running _grow_state
+                # would re-initialize the new head columns and destroy them.
+                resume_epoch = (
+                    self.start_epoch if task_id == self.start_task else 0
+                )
+                if resume_epoch == 0:
+                    # Head growth before training (reference template.py:241).
+                    with tel.span("head_grow", task=task_id):
+                        self.state = self._grow_state(
+                            self.state, task_id, self.known, nb_new
+                        )
                 t0 = time.time()
-                self._fit_task(task_id, task_train, dataset_val)
+                self._fit_task(
+                    task_id, task_train, dataset_val, nb_new,
+                    start_epoch=resume_epoch,
+                )
                 if self.recompile_sentinel is not None:
                     # All legitimate train compiles for this task happened;
                     # anything beyond the granted budget is a leak.
@@ -516,10 +552,22 @@ class CilTrainer:
         m = incs[task_id]
         return n / (n + m)
 
-    def _fit_task(self, task_id: int, task_train, dataset_val) -> None:
+    def _fit_task(
+        self,
+        task_id: int,
+        task_train,
+        dataset_val,
+        nb_new: int = 0,
+        start_epoch: int = 0,
+    ) -> None:
         """Per-task epoch loop; the per-epoch work is delegated to either the
         fused-epoch program or the per-batch step loop (same scaffold:
         profiling, cosine LR, key derivation, metric logging, eval cadence).
+
+        ``start_epoch > 0`` continues a task an epoch-checkpoint restore
+        dropped us into: every epoch's key/permutation is a pure function of
+        ``(seed, task, epoch)``, so skipping the completed epochs replays the
+        remainder bit-for-bit.
         """
         cfg = self.config
         # Fused-epoch path: whole-epoch lax.scan with the dataset in HBM.
@@ -533,10 +581,10 @@ class CilTrainer:
         lam = self._lambda_kd(task_id)
         from ..utils.profiling import task_trace
 
-        for epoch in range(cfg.num_epochs):
-            # Trace the first epoch of each task when profiling is on (the
-            # later epochs replay the same compiled program).
-            profile_here = cfg.profile_dir if epoch == 0 else None
+        for epoch in range(start_epoch, cfg.num_epochs):
+            # Trace the first executed epoch of each task when profiling is
+            # on (the later epochs replay the same compiled program).
+            profile_here = cfg.profile_dir if epoch == start_epoch else None
             t_epoch = time.perf_counter()
             lr = cosine_lr(cfg.lr, epoch, cfg.num_epochs)
             epoch_key = jax.random.fold_in(
@@ -575,12 +623,13 @@ class CilTrainer:
             print(
                 f"train states: epoch :[{epoch + 1}/{cfg.num_epochs}] {logger}"
             )
-            # A task's first epoch legitimately compiles its shapes (grown
-            # head, new scan length); train-program growth at any later
+            # A task's first executed epoch legitimately compiles its shapes
+            # (grown head, new scan length — or a fresh process after an
+            # epoch-checkpoint restore); train-program growth at any later
             # epoch is the silent mid-steady-state recompile bug and warns.
             self.telemetry.recompiles.check(
                 where=f"task{task_id}/epoch{epoch + 1}",
-                expected=(epoch == 0),
+                expected=(epoch == start_epoch),
                 group="train",
                 task_id=task_id,
                 epoch=epoch + 1,
@@ -602,6 +651,32 @@ class CilTrainer:
             self.telemetry.heartbeat.update(
                 force=True, task=task_id, epoch=epoch + 1
             )
+            # Mid-task durability: an epoch checkpoint every E epochs bounds
+            # the replay after a kill to < E epochs instead of the whole
+            # task.  A *transient* save failure (full disk, flaky NFS — or
+            # the injected save_ioerror) must not kill a healthy run; it
+            # costs durability, not correctness, so log and continue.
+            if (cfg.ckpt_dir and cfg.epoch_ckpt_every > 0
+                    and (epoch + 1) % cfg.epoch_ckpt_every == 0):
+                from ..utils.checkpoint import save_epoch_checkpoint
+
+                try:
+                    with self.telemetry.span(
+                        "epoch_checkpoint", task=task_id, epoch=epoch + 1
+                    ):
+                        save_epoch_checkpoint(self, task_id, epoch + 1, nb_new)
+                except OSError as e:
+                    print(f"| epoch checkpoint save failed: {e!r}")
+                    self.jsonl.log(
+                        "ckpt_save_error", error=repr(e),
+                        task_id=task_id, epoch=epoch + 1,
+                    )
+            # The engine.epoch injection point sits AFTER the epoch's
+            # checkpoint hook on purpose: kill@taskT.epochE leaves epoch E's
+            # checkpoint on disk, so the supervised relaunch resumes at
+            # exactly the boundary the kill named.
+            if self.faults is not None:
+                self.faults.fire("engine.epoch", task=task_id, epoch=epoch + 1)
             # Reference cadence exactly (template.py:282-283): when num_epochs
             # is a multiple of eval_every_epoch this evals once more at the
             # final pre-alignment epoch, in addition to the post-alignment
@@ -639,6 +714,14 @@ class CilTrainer:
 
         def _placed(item):
             step_idx, (xb, yb) = item
+            # data.produce injection point: runs on the producer thread at
+            # depth > 0 (producer_die exercises the graceful degradation
+            # below; slow_batch models a hitching input pipeline).
+            if self.faults is not None:
+                self.faults.fire(
+                    "data.produce", task=task_id, epoch=epoch + 1,
+                    step=step_idx + 1,
+                )
             xb = self._decode(xb, train=True, seed=shuffle_seed + step_idx)
             # Same key on every process (replicated jit operands must be
             # process-consistent); per-image randomness comes from the
@@ -646,6 +729,12 @@ class CilTrainer:
             key = jax.random.fold_in(epoch_key, step_idx)
             x, y = self._put(xb, yb)
             return x, y, key
+
+        def _degraded(exc):
+            self.jsonl.log(
+                "prefetch_degraded", where="train", error=repr(exc),
+                task_id=task_id, epoch=epoch + 1,
+            )
 
         source = enumerate(
             train_batches(
@@ -659,7 +748,9 @@ class CilTrainer:
             cfg.prefetch_depth,
             clock=clock,
             name=f"prefetch-train-t{task_id}",
+            on_degrade=_degraded,
         ) as batches:
+            step_no = 0
             for x, y, key in batches:
                 t_step = time.perf_counter()
                 with clock.device():
@@ -668,6 +759,7 @@ class CilTrainer:
                     )
                 pending.append(metrics)
                 self._global_step += 1
+                step_no += 1
                 hb.update(
                     step=self._global_step,
                     task=task_id,
@@ -676,6 +768,13 @@ class CilTrainer:
                         (time.perf_counter() - t_step) * 1e3, 2
                     ),
                 )
+                # engine.step fires after the step's dispatch, so a kill at
+                # step S never loses steps < S from the run's metrics.
+                if self.faults is not None:
+                    self.faults.fire(
+                        "engine.step", task=task_id, epoch=epoch + 1,
+                        step=step_no,
+                    )
         # ONE device->host transfer for the whole epoch's metrics: per-scalar
         # fetches cost a full RPC round trip each on tunneled TPU platforms
         # (~90 ms measured), which would dwarf the steps themselves.
@@ -737,12 +836,18 @@ class CilTrainer:
             xb = self._decode(xb, train=False, seed=0)
             return self._put(xb, yb, wb)
 
+        def _degraded(exc):
+            self.jsonl.log(
+                "prefetch_degraded", where="eval", error=repr(exc),
+            )
+
         totals = None
         with DevicePrefetcher(
             eval_batches(dataset_val, self.global_batch_size, pidx, pcount),
             _placed,
             self.config.prefetch_depth,
             name="prefetch-eval",
+            on_degrade=_degraded,
         ) as batches:
             for x, y, w in batches:
                 out = self.eval_step(
@@ -794,11 +899,18 @@ class CilTrainer:
             x = self._put(xb, sharding=rep)
             return x, jax.random.fold_in(feat_key, i)
 
+        def _degraded(exc):
+            self.jsonl.log(
+                "prefetch_degraded", where="herd", error=repr(exc),
+                task_id=task_id,
+            )
+
         with DevicePrefetcher(
             enumerate(sequential_batches(task_train, self.global_batch_size)),
             _placed,
             cfg.prefetch_depth,
             name="prefetch-herd",
+            on_degrade=_degraded,
         ) as batches:
             for x, key in batches:
                 f = self.feature_step(
@@ -824,4 +936,14 @@ class CilTrainer:
         if self.config.ckpt_dir:
             from ..utils.checkpoint import save_task_checkpoint
 
-            save_task_checkpoint(self, task_id)
+            try:
+                save_task_checkpoint(self, task_id)
+            except OSError as e:
+                # Transient save failure (or injected save_ioerror): the run
+                # loses durability for this boundary, not correctness — the
+                # fallback scan will resume from the newest checkpoint that
+                # did land.  Logged so the evidence trail shows the gap.
+                print(f"| task checkpoint save failed: {e!r}")
+                self.jsonl.log(
+                    "ckpt_save_error", error=repr(e), task_id=task_id
+                )

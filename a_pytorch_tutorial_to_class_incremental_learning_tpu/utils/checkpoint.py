@@ -1,36 +1,62 @@
-"""Per-task checkpoint/resume.
+"""Task- and epoch-granular checkpoint/resume.
 
 The reference never persists anything — a crash in task 7 of 10 loses the run
 (SURVEY.md §5 "checkpoint/resume: absent"); on TPU pods preemption makes this
-mandatory.  Granularity is the task boundary: after task t finishes (post
-weight-align, post herding) we persist everything ``fit()`` needs to continue
-at task t+1 — params, batch stats, rehearsal memory, accuracy history, class
-bookkeeping.  Momentum is *not* saved because the reference re-initializes the
-optimizer every task anyway (``template.py:246``), so task-boundary resume is
-exact: a killed-and-resumed run reproduces the uninterrupted run bit-for-bit
-(same PRNG folds, same shuffles, same memory).
+mandatory.  Two granularities:
+
+* **Task boundary** (always on with ``--ckpt_dir``): after task t finishes
+  (post weight-align, post herding) we persist everything ``fit()`` needs to
+  continue at task t+1 — params, batch stats, rehearsal memory, accuracy
+  history, class bookkeeping.  Momentum is *not* saved because the reference
+  re-initializes the optimizer every task anyway (``template.py:246``).
+* **Epoch boundary** (``--epoch_ckpt_every E``): mid-task
+  ``task_{t}_epoch_{e}.ckpt`` files additionally capture the optimizer
+  momentum, the teacher snapshot and the mid-task rehearsal/accuracy state,
+  so a kill mid-task resumes at the last epoch boundary instead of replaying
+  the whole task.  Resume is exact either way: every epoch's RNG is a pure
+  fold of ``(seed, task, epoch)`` and its shuffle permutation a pure hash of
+  the same triple (engine/loop.py), and the rehearsal memory only mutates at
+  task boundaries — so the permutation cursor at an epoch boundary is always
+  0 and a killed-and-resumed run reproduces the uninterrupted twin
+  bit-for-bit.  Epoch checkpoints are deleted once their task's boundary
+  checkpoint lands.
+
+Integrity: every pickle payload gets a ``.sha256`` sidecar (for orbax, over
+the ``.meta`` sidecar — orbax finalizes its own directory atomically).
+Restore verifies the checksum and test-unpickles each candidate, falling back
+to the newest *valid* checkpoint (logging a ``ckpt_fallback`` record per
+skipped file) instead of crashing on a truncated or bit-flipped file.  Stale
+``*.tmp`` leftovers from a crashed save are deleted on scan, never resumed
+from.  Write order makes every crash window safe: payload tmp → checksum
+sidecar → atomic rename (an orphan sidecar without its payload is ignored).
 
 Two on-disk formats (``--ckpt_backend``):
 
 * ``pickle`` (default): one pickle per task of host numpy pytrees (atomic
   rename), written by process 0 only.  Fine while parameters are replicated.
+  Epoch checkpoints always use this format.
 * ``orbax``: the *device array* state (params + batch stats) goes through
   orbax/tensorstore — every process writes its own shards and restore places
   arrays directly onto the mesh sharding, so no device array gathers to one
   host.  Host-side metadata (rehearsal memory, accuracy history,
-  bookkeeping) still funnels through a process-0 sidecar pickle — and the
-  rehearsal memory_store in it is the largest host-side state (up to
-  ``memory_size`` raw images), so the no-gather property applies to device
-  state only.  A checkpoint counts as complete only when both the sidecar
-  and orbax's atomically-finalized directory exist.
+  bookkeeping) still funnels through a process-0 sidecar pickle.  A
+  checkpoint counts as complete only when both the sidecar and orbax's
+  atomically-finalized directory exist.
+
+Fault injection (``--fault_spec``): the saves call the trainer's injector at
+site ``ckpt.save`` and apply the cooperative actions — ``save_ioerror``
+raises before any byte is written, ``truncate_ckpt``/``corrupt_ckpt`` damage
+the finished payload *without* refreshing its checksum, exactly the torn-write
+and bit-rot failures the fallback scan exists to survive.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import re
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,10 +64,17 @@ import numpy as np
 
 from ..parallel.dist import barrier, is_main_process
 
+_TASK_RE = re.compile(r"task_(\d+)\.(ckpt|orbax)")
+_EPOCH_RE = re.compile(r"task_(\d+)_epoch_(\d+)\.ckpt")
+
 
 def _task_path(ckpt_dir: str, task_id: int, backend: str = "pickle") -> str:
     ext = "orbax" if backend == "orbax" else "ckpt"
     return os.path.join(ckpt_dir, f"task_{task_id:03d}.{ext}")
+
+
+def _epoch_path(ckpt_dir: str, task_id: int, epoch: int) -> str:
+    return os.path.join(ckpt_dir, f"task_{task_id:03d}_epoch_{epoch:03d}.ckpt")
 
 
 def _to_host(tree):
@@ -60,12 +93,164 @@ def _metadata(trainer, task_id: int) -> dict:
     }
 
 
+# --------------------------------------------------------------------- #
+# Integrity: sha256 sidecars + validated reads
+# --------------------------------------------------------------------- #
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_sidecar(payload_path: str, payload_tmp: str) -> None:
+    """Checksum of the (still-tmp) payload, landed atomically at
+    ``<payload>.sha256`` *before* the payload's own rename — a crash between
+    the two leaves an orphan sidecar, which readers ignore."""
+    digest = _sha256_file(payload_tmp)
+    tmp = payload_path + ".sha256.tmp"
+    with open(tmp, "w") as f:
+        f.write(digest + "\n")
+    os.replace(tmp, payload_path + ".sha256")
+
+
+def _payload_file(path: str) -> str:
+    """The pickle that integrity checks cover (orbax keeps its metadata in a
+    ``.meta`` sidecar; the orbax directory finalizes atomically on its own)."""
+    return path + ".meta" if path.endswith(".orbax") else path
+
+
+def _read_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """Checksum-verify and unpickle; ``(payload, None)`` or ``(None, why)``.
+
+    A payload without a sidecar (pre-checksum checkpoints) is accepted iff it
+    unpickles — truncation still fails the unpickle; only a bit-flip that
+    keeps the pickle well-formed needs the sidecar to be caught.
+    """
+    target = _payload_file(path)
+    if not os.path.exists(target):
+        return None, "missing payload"
+    sidecar = target + ".sha256"
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            want = f.read().strip()
+        got = _sha256_file(target)
+        if got != want:
+            return None, f"checksum mismatch (want {want[:12]}, got {got[:12]})"
+    try:
+        with open(target, "rb") as f:
+            return pickle.load(f), None  # noqa: S301 - trusted local checkpoint
+    except Exception as e:  # pickle raises half the exception zoo on torn files
+        return None, f"unreadable payload: {e!r}"
+
+
+# --------------------------------------------------------------------- #
+# Candidate scan
+# --------------------------------------------------------------------- #
+
+
+def checkpoint_candidates(ckpt_dir: str) -> List[Tuple[int, Optional[int], str]]:
+    """Resume candidates newest-progress-first as ``(task, epoch, path)``.
+
+    ``epoch is None`` marks a task-boundary checkpoint, which outranks every
+    epoch checkpoint of the same task (the task is fully done, align+herd
+    included) and every checkpoint of earlier tasks.  Stale ``*.tmp`` /
+    ``*.meta.tmp`` leftovers from a crashed save are deleted here — a torn
+    temp file must never be picked (or even seen) as a resume point.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return []
+    ranked = []
+    for name in sorted(os.listdir(ckpt_dir)):
+        path = os.path.join(ckpt_dir, name)
+        if name.endswith(".tmp"):
+            try:
+                os.remove(path)
+                print(f"| removed stale checkpoint temp file {path}")
+            except OSError:
+                pass  # multi-process scan race: the loser's delete is done
+            continue
+        m = _TASK_RE.fullmatch(name)
+        if m:
+            if m.group(2) == "orbax" and not os.path.exists(path + ".meta"):
+                continue  # incomplete: sidecar missing
+            ranked.append((int(m.group(1)), float("inf"), path))
+            continue
+        m = _EPOCH_RE.fullmatch(name)
+        if m:
+            ranked.append((int(m.group(1)), float(m.group(2)), path))
+    ranked.sort(key=lambda it: (it[0], it[1]), reverse=True)
+    return [(t, None if e == float("inf") else int(e), p) for t, e, p in ranked]
+
+
+def latest_task_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest checkpoint that actually verifies (checksum + unpickle)."""
+    for _task, _epoch, path in checkpoint_candidates(ckpt_dir):
+        payload, _why = _read_payload(path)
+        if payload is not None:
+            return path
+    return None
+
+
+# --------------------------------------------------------------------- #
+# Saves
+# --------------------------------------------------------------------- #
+
+
+def _fire_save_faults(trainer, task_id: int, epoch: Optional[int] = None):
+    faults = getattr(trainer, "faults", None)
+    if faults is None:
+        return ()
+    coords = {"task": task_id}
+    if epoch is not None:
+        coords["epoch"] = epoch
+    actions = faults.fire("ckpt.save", **coords)
+    if "save_ioerror" in actions:
+        raise OSError(
+            f"fault-injected transient checkpoint save failure "
+            f"(task {task_id}, epoch {epoch})"
+        )
+    return actions
+
+
+def _apply_payload_faults(actions, path: str) -> None:
+    """Damage the *finished* payload the way real storage does — after the
+    rename, without touching the checksum sidecar."""
+    if not actions or not is_main_process():
+        return
+    target = _payload_file(path)
+    size = os.path.getsize(target)
+    if "truncate_ckpt" in actions:
+        with open(target, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        print(f"| fault: truncated {target} to {max(size // 2, 1)} bytes")
+    if "corrupt_ckpt" in actions:
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([(byte[0] if byte else 0) ^ 0xFF]))
+        print(f"| fault: flipped a byte at offset {size // 2} of {target}")
+
+
+def _write_pickle_atomic(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    _write_sidecar(path, tmp)
+    os.replace(tmp, path)
+
+
 def save_task_checkpoint(trainer, task_id: int) -> str:
     """Persist post-task state (called by ``CilTrainer.fit`` when
     ``ckpt_dir`` is set)."""
     ckpt_dir = trainer.config.ckpt_dir
     backend = trainer.config.ckpt_backend
     path = _task_path(ckpt_dir, task_id, backend)
+    actions = _fire_save_faults(trainer, task_id)
     if backend == "orbax":
         import orbax.checkpoint as ocp
 
@@ -74,12 +259,7 @@ def save_task_checkpoint(trainer, task_id: int) -> str:
             # Sidecar first: resume requires sidecar AND the orbax dir, and
             # orbax finalizes its directory atomically — so a crash between
             # the two writes never yields a half-checkpoint that loads.
-            tmp = path + ".meta.tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(
-                    _metadata(trainer, task_id), f, protocol=pickle.HIGHEST_PROTOCOL
-                )
-            os.replace(tmp, path + ".meta")
+            _write_pickle_atomic(path + ".meta", _metadata(trainer, task_id))
         barrier()
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(
@@ -97,74 +277,158 @@ def save_task_checkpoint(trainer, task_id: int) -> str:
         payload = _metadata(trainer, task_id)
         payload["params"] = _to_host(trainer.state.params)
         payload["batch_stats"] = _to_host(trainer.state.batch_stats)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
+        _write_pickle_atomic(path, payload)
+    _apply_payload_faults(actions, path)
+    if is_main_process():
+        _drop_epoch_checkpoints(ckpt_dir, task_id)
     barrier()
     return path
 
 
-def latest_task_checkpoint(ckpt_dir: str) -> Optional[str]:
+def save_epoch_checkpoint(trainer, task_id: int, epoch: int, nb_new: int) -> str:
+    """Persist mid-task state after ``epoch`` completed epochs (1-based).
+
+    Beyond the task-boundary payload this carries the optimizer momentum (a
+    task boundary discards it, an epoch boundary must not), the teacher
+    snapshot, the *pre-task* ``known``/``nb_new`` split, and the RNG
+    provenance — everything ``load_task_checkpoint`` needs to drop the
+    resumed process into ``_fit_task`` at ``start_epoch == epoch`` with
+    device state bit-identical to the uninterrupted twin's.  Always pickle
+    (process 0), even under the orbax backend: epoch checkpoints are
+    high-frequency scratch state, deleted at the next task boundary.
+    """
+    ckpt_dir = trainer.config.ckpt_dir
+    path = _epoch_path(ckpt_dir, task_id, epoch)
+    actions = _fire_save_faults(trainer, task_id, epoch=epoch)
+    if is_main_process():
+        os.makedirs(ckpt_dir, exist_ok=True)
+        teacher = None
+        if trainer.teacher is not None:
+            teacher = {
+                "params": _to_host(trainer.teacher.params),
+                "batch_stats": _to_host(trainer.teacher.batch_stats),
+            }
+        payload = {
+            "task_id": task_id,
+            "epoch": epoch,               # completed epochs, 1-based
+            "known": trainer.known,       # pre-task (the task is mid-flight)
+            "nb_new": nb_new,
+            "acc1s": list(trainer.acc1s),
+            "acc_matrix": [list(r) if r is not None else None
+                           for r in trainer.acc_matrix],
+            "memory_store": trainer.memory._store,
+            "config_seed": trainer.config.seed,
+            "params": _to_host(trainer.state.params),
+            "batch_stats": _to_host(trainer.state.batch_stats),
+            "momentum": _to_host(trainer.state.momentum),
+            "teacher": teacher,
+            "global_step": trainer._global_step,
+            # Provenance, not state: epoch e+1's key is a pure fold of
+            # (seed, task, epoch) and its permutation a pure hash of the same
+            # triple, so the resume cursor at an epoch boundary is always 0.
+            "rng": {"root_seed": trainer.config.seed, "task_fold": task_id,
+                    "next_epoch": epoch},
+            "perm_cursor": 0,
+        }
+        _write_pickle_atomic(path, payload)
+    _apply_payload_faults(actions, path)
+    barrier()
+    return path
+
+
+def _drop_epoch_checkpoints(ckpt_dir: str, task_id: int) -> None:
+    """The task-boundary checkpoint supersedes its task's epoch scratch."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    best = None
+        return
     for name in os.listdir(ckpt_dir):
-        m = re.fullmatch(r"task_(\d+)\.(ckpt|orbax)", name)
-        if not m:
-            continue
-        path = os.path.join(ckpt_dir, name)
-        if m.group(2) == "orbax" and not os.path.exists(path + ".meta"):
-            continue  # incomplete: sidecar missing
-        if best is None or int(m.group(1)) > best[0]:
-            best = (int(m.group(1)), path)
-    return best[1] if best else None
+        m = _EPOCH_RE.fullmatch(name)
+        if m and int(m.group(1)) == task_id:
+            for victim in (name, name + ".sha256"):
+                try:
+                    os.remove(os.path.join(ckpt_dir, victim))
+                except OSError:
+                    pass  # the sidecar may legitimately not exist
+
+
+# --------------------------------------------------------------------- #
+# Restore
+# --------------------------------------------------------------------- #
+
+
+def _parse_ckpt_name(path: str) -> Tuple[int, Optional[int]]:
+    name = os.path.basename(path)
+    m = _EPOCH_RE.fullmatch(name)
+    if m:
+        return int(m.group(1)), int(m.group(2))
+    m = _TASK_RE.fullmatch(name)
+    if m:
+        return int(m.group(1)), None
+    return -1, None
 
 
 def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
-    """Restore a trainer to the state right after the checkpointed task.
+    """Restore a trainer from the newest *valid* checkpoint.
 
-    Returns True when a checkpoint was found and loaded; ``trainer.fit()``
-    then skips tasks ``<= task_id`` via ``start_task``.
+    Task-boundary payloads restore to "right after task t" (``fit()`` skips
+    tasks ``<= t`` via ``start_task``); epoch payloads restore to "task t,
+    ``start_epoch`` epochs done" mid-task.  Candidates that fail the checksum
+    or unpickle are skipped with a ``ckpt_fallback`` record, falling back to
+    the next-newest valid one.  Returns True when something was loaded.
     """
     from ..engine.train import Teacher, sgd_init
     from ..parallel.mesh import replicated_scalar, shard_params
 
-    path = path or latest_task_checkpoint(trainer.config.ckpt_dir or "")
-    found_task = -1
-    if path and os.path.exists(path):
-        m = re.search(r"task_(\d+)\.(ckpt|orbax)$", path)
-        found_task = int(m.group(1)) if m else -1
+    sink = getattr(trainer, "jsonl", None)
+    if path is not None:
+        task_id, epoch = _parse_ckpt_name(path)
+        candidates = [(task_id, epoch, path)] if os.path.exists(
+            _payload_file(path)
+        ) else []
+    else:
+        candidates = checkpoint_candidates(trainer.config.ckpt_dir or "")
+    chosen = None
+    for task_id, epoch, cand in candidates:
+        payload, why = _read_payload(cand)
+        if payload is None:
+            print(f"| skipping invalid checkpoint {cand}: {why}")
+            if sink is not None:
+                sink.log("ckpt_fallback", skipped=cand, reason=why)
+            continue
+        chosen = (task_id, epoch, cand, payload)
+        break
     # Multi-host: every process must agree on the resume point, or they would
     # run different programs and deadlock.  Fail loudly on disagreement
-    # (e.g. ckpt_dir on non-shared storage).
+    # (e.g. ckpt_dir on non-shared storage).  The encoding orders resume
+    # points exactly like checkpoint_candidates: task major, epoch minor,
+    # task-boundary (epoch None) above any epoch of the same task.
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
+        found = -1
+        if chosen is not None:
+            t, e, _, _ = chosen
+            found = t * 1_000_000 + (999_999 if e is None else e)
         seen = multihost_utils.process_allgather(
-            np.asarray(found_task, dtype=np.int64)
+            np.asarray(found, dtype=np.int64)
         )
         if len(np.unique(seen)) != 1:
             raise RuntimeError(
                 f"processes disagree on the latest checkpoint ({seen.tolist()}); "
                 "is ckpt_dir on storage shared by all hosts?"
             )
-    if found_task < 0:
+    if chosen is None:
         return False
-    if path.endswith(".orbax"):
-        import orbax.checkpoint as ocp
-
-        with open(path + ".meta", "rb") as f:
-            payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint
-    else:
-        with open(path, "rb") as f:
-            payload = pickle.load(f)  # noqa: S301 - trusted local checkpoint
+    task_id, epoch, path, payload = chosen
     if payload["config_seed"] != trainer.config.seed:
         raise ValueError(
             f"checkpoint seed {payload['config_seed']} != config seed "
             f"{trainer.config.seed}; refusing silent mix of experiments"
         )
+    if epoch is not None:
+        return _restore_epoch(trainer, path, payload)
     if path.endswith(".orbax"):
+        import orbax.checkpoint as ocp
+
         # Restore straight onto the mesh sharding: the static full-width head
         # keeps every array's shape constant across tasks, so the live state
         # is its own restore template — no host-side gather at any point.
@@ -254,9 +518,74 @@ def load_task_checkpoint(trainer, path: Optional[str] = None) -> bool:
     trainer.acc_matrix = matrix
     trainer.memory._store = payload["memory_store"]
     trainer.start_task = payload["task_id"] + 1
+    trainer.start_epoch = 0
+    trainer.resumed_from = {"path": path, "kind": "task"}
     sentinel = getattr(trainer, "recompile_sentinel", None)
     if sentinel is not None:
         # A restore legitimately (re)compiles the resumed task's programs.
         sentinel.note_event("restore", task_id=payload["task_id"])
     print(f"| resumed from {path}: next task {trainer.start_task}, known={known}")
+    return True
+
+
+def _restore_epoch(trainer, path: str, payload: dict) -> bool:
+    """Drop the trainer mid-task: task ``task_id`` already grew its head and
+    ran ``epoch`` epochs; ``fit()`` continues that task at ``start_epoch``
+    (skipping ``_grow_state`` — the restored params are post-growth)."""
+    from ..engine.train import Teacher
+    from ..parallel.mesh import replicated_scalar, shard_params
+
+    copy_in = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        jnp.copy, shard_params(trainer.mesh, tree)
+    )
+    # Same re-homing rule as the task branch: unpickled host buffers must
+    # never reach the donating train programs (zero-copy device_put aliasing).
+    params = copy_in(payload["params"])
+    batch_stats = copy_in(payload["batch_stats"])
+    momentum = copy_in(payload["momentum"])
+    if getattr(trainer.config, "check_donation", False):
+        from analysis.runtime import assert_unaliased, poison_host_tree
+
+        host_state = {k: payload[k] for k in ("params", "batch_stats", "momentum")}
+        assert_unaliased(
+            host_state,
+            {"params": params, "batch_stats": batch_stats, "momentum": momentum},
+            where=path,
+        )
+        poison_host_tree(host_state)
+    known = int(payload["known"])
+    nb_new = int(payload["nb_new"])
+    trainer.state = trainer.state.replace(
+        params=params,
+        batch_stats=batch_stats,
+        momentum=momentum,  # mid-task: the optimizer is live, not reset
+        num_active=replicated_scalar(trainer.mesh, known + nb_new),
+        known=replicated_scalar(trainer.mesh, known),
+    )
+    if payload["teacher"] is not None:
+        trainer.teacher = Teacher(
+            params=copy_in(payload["teacher"]["params"]),
+            batch_stats=copy_in(payload["teacher"]["batch_stats"]),
+            known=replicated_scalar(trainer.mesh, known),
+        )
+    else:
+        trainer.teacher = None
+    trainer.known = known
+    trainer.acc1s = list(payload["acc1s"])
+    matrix = [list(r) if r is not None else None
+              for r in payload.get("acc_matrix", [])]
+    matrix += [None] * (len(payload["acc1s"]) - len(matrix))
+    trainer.acc_matrix = matrix
+    trainer.memory._store = payload["memory_store"]
+    trainer.start_task = payload["task_id"]
+    trainer.start_epoch = int(payload["epoch"])
+    trainer._global_step = int(payload.get("global_step", 0))
+    trainer.resumed_from = {"path": path, "kind": "epoch"}
+    sentinel = getattr(trainer, "recompile_sentinel", None)
+    if sentinel is not None:
+        sentinel.note_event("restore", task_id=payload["task_id"])
+    print(
+        f"| resumed from {path}: task {trainer.start_task} at epoch "
+        f"{trainer.start_epoch + 1}, known={known}+{nb_new}"
+    )
     return True

@@ -19,6 +19,11 @@ Rule catalog (see README "Static analysis"):
   ``np.asarray`` / ``jax.device_get`` inside a ``for ... in <batches>`` loop.
 * JL301 — thread-shared state: a ``self.*`` attribute written by both the
   producer thread target and consumer methods without holding the lock.
+* JL302 — swallowed error: a bare ``except:`` / ``except Exception`` /
+  ``except BaseException`` whose body neither re-raises, nor reads the bound
+  exception, nor reports it (log/print/warn) — on the training hot paths a
+  silently eaten error turns a crash the supervisor could recover from into
+  a wrong-numbers run nobody notices.
 
 The donation pass is a light abstract interpreter: it tracks which local
 names/attributes are bound to donating callables (including builder
@@ -46,6 +51,7 @@ RULES: Dict[str, str] = {
     "JL102": "branch on a traced value inside a jitted function",
     "JL201": "host sync inside a device hot loop",
     "JL301": "attribute written by producer thread and consumer outside the lock",
+    "JL302": "over-broad except handler silently swallows the error",
 }
 
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
@@ -855,6 +861,66 @@ def _attr_writes(fn: ast.AST, locked: bool = False):
 
 
 # --------------------------------------------------------------------------- #
+# JL302: over-broad except handlers that swallow the error
+# --------------------------------------------------------------------------- #
+
+_BROAD_EXC = {"Exception", "BaseException"}
+# A call whose dotted name contains one of these counts as reporting the
+# failure somewhere a human (or the telemetry pipeline) can see it.
+_REPORT_MARKERS = ("log", "print", "warn", "report", "record", "debug", "emit")
+
+
+def run_swallowed_errors(path: str, tree: ast.Module, out: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _broad_handler(node.type):
+            continue
+        if node.name and _name_read(node.body, node.name):
+            continue  # the handler inspects/propagates the exception object
+        if any(isinstance(sub, ast.Raise)
+               for st in node.body for sub in ast.walk(st)):
+            continue  # re-raised (or converted): nothing is swallowed
+        if _reports(node.body):
+            continue
+        caught = "bare except" if node.type is None else \
+            f"except {ast.unparse(node.type)}"
+        out.append(Finding(
+            path, node.lineno, node.col_offset, "JL302",
+            f"`{caught}` swallows the error without re-raising, reading it, "
+            "or reporting it — on a hot path this turns a recoverable crash "
+            "into silent wrong numbers; narrow the exception type, log it, "
+            "or suppress with a reasoned `# jaxlint: disable=JL302`",
+        ))
+
+
+def _broad_handler(t: Optional[ast.expr]) -> bool:
+    if t is None:
+        return True  # bare except:
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any((dotted(e) or "").split(".")[-1] in _BROAD_EXC for e in elts)
+
+
+def _name_read(body: List[ast.stmt], name: str) -> bool:
+    for st in body:
+        for sub in ast.walk(st):
+            if isinstance(sub, ast.Name) and sub.id == name \
+                    and isinstance(sub.ctx, ast.Load):
+                return True
+    return False
+
+
+def _reports(body: List[ast.stmt]) -> bool:
+    for st in body:
+        for sub in ast.walk(st):
+            if isinstance(sub, ast.Call):
+                fname = (dotted(sub.func) or "").lower()
+                if any(m in fname for m in _REPORT_MARKERS):
+                    return True
+    return False
+
+
+# --------------------------------------------------------------------------- #
 # driver
 # --------------------------------------------------------------------------- #
 
@@ -866,4 +932,5 @@ def run_rules(path: str, tree: ast.Module, index: ProjectIndex) -> List[Finding]
     run_branch_on_tracer(path, tree, out)
     run_host_sync(path, tree, out)
     run_thread_shared(path, tree, out)
+    run_swallowed_errors(path, tree, out)
     return out

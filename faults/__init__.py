@@ -1,0 +1,15 @@
+"""Deterministic fault injection (see :mod:`faults.injector`).
+
+Stdlib-only, like ``analysis/``: the injector must be importable (and its
+specs parseable) without jax, so the supervisor and tests can reason about
+fault plans outside a training process.
+"""
+
+from .injector import (  # noqa: F401
+    ACTIONS,
+    FaultClause,
+    FaultInjected,
+    FaultInjector,
+    injector_from,
+    parse_fault_spec,
+)

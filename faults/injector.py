@@ -1,0 +1,270 @@
+"""Deterministic fault injection: named injection points on the hot paths.
+
+On TPU pods preemption is the common case, not the exception — Podracer-style
+architectures (arXiv:2104.06272) treat kill-and-relaunch as the normal
+lifecycle — yet none of this repo's recovery paths (resume, heartbeat
+supervision, prefetch producer death, checkpoint corruption) would ever run
+in CI unless something *injects* the failure.  This module makes faults
+reproducible: a ``--fault_spec`` names exact coordinates (task/epoch/step)
+where a specific failure fires, once, with a durable ledger so a relaunched
+process does not re-fire the same fault into a crash loop.
+
+Spec grammar (comma-separated clauses)::
+
+    <action>@task<T>[.epoch<E>[.step<S>]]
+
+    kill@task1.epoch3          SIGKILL after task 1's 3rd epoch completes
+    raise@task0.epoch2.step7   raise FaultInjected after step 7 of the epoch
+    producer_die@task1.epoch1.step3   prefetch producer thread dies there
+    slow_batch@task0.epoch1.step2     producer sleeps 0.25 s on that batch
+    corrupt_ckpt@task2         bit-flip the first checkpoint saved for task 2
+    truncate_ckpt@task1.epoch2 truncate that epoch checkpoint's payload
+    save_ioerror@task0         transient OSError on task 0's checkpoint save
+
+Coordinates use the run-log numbering: ``task`` is the 0-based ``task_id``,
+``epoch``/``step`` are 1-based like the ``epoch`` records.  Unspecified
+coordinates are wildcards (``kill@task1`` fires at the end of task 1's first
+epoch); a kill/raise clause without a ``step`` coordinate never fires at the
+per-step site — mid-epoch would strike before the named epoch's checkpoint
+exists.  Engine coordinates fire at the *end* of the named unit —
+after the epoch's checkpoint hook, after the step's dispatch — so a kill at
+``task1.epoch3`` leaves the epoch-3 checkpoint on disk and the resumed twin
+replays from exactly there.  ``step``/``producer``-level sites exist only on
+the per-batch path (``--no_fused_epochs``); the fused epoch is one opaque
+device program.
+
+Each clause fires **once**.  With a ledger path (defaulted to
+``<ckpt_dir>/fault_ledger.jsonl`` by the trainer), the firing is recorded
+durably *before* the action executes, so a SIGKILL'd-and-relaunched process
+parses the same ``--fault_spec`` but finds the clause already spent — the
+relaunch runs clean instead of crash-looping.  Every firing also emits a
+schema-checked ``fault_injected`` record to the run log.
+
+Zero overhead when unset: without ``--fault_spec`` the trainer holds ``None``
+and the hot paths pay one identity check per site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# action -> sites where it may fire.  Sites are the code locations that call
+# ``fire(site, ...)``:
+#   engine.epoch   engine/loop.py, end of each epoch (after the epoch-
+#                  checkpoint hook)            coords: task, epoch
+#   engine.step    engine/loop.py, after each per-batch train step
+#                                              coords: task, epoch, step
+#   data.produce   the prefetch producer path (engine/loop.py ``_placed``,
+#                  runs on the producer thread at depth > 0)
+#                                              coords: task, epoch, step
+#   ckpt.save      utils/checkpoint.py, before/after each checkpoint write
+#                                              coords: task[, epoch]
+ACTIONS: Dict[str, frozenset] = {
+    "kill": frozenset({"engine.epoch", "engine.step"}),
+    "raise": frozenset({"engine.epoch", "engine.step"}),
+    "producer_die": frozenset({"data.produce"}),
+    "slow_batch": frozenset({"data.produce"}),
+    "corrupt_ckpt": frozenset({"ckpt.save"}),
+    "truncate_ckpt": frozenset({"ckpt.save"}),
+    "save_ioerror": frozenset({"ckpt.save"}),
+}
+
+# Actions fire() performs itself vs. actions the call site must apply (a
+# checkpoint file can only be corrupted by the code that knows its path).
+COOPERATIVE = frozenset({"corrupt_ckpt", "truncate_ckpt", "save_ioerror"})
+
+# step nests inside epoch (a step coordinate without its epoch is ambiguous
+# across epochs, so the grammar forbids it).
+_CLAUSE_RE = re.compile(
+    r"(?P<action>[a-z_]+)@task(?P<task>\d+)"
+    r"(?:\.epoch(?P<epoch>\d+)(?:\.step(?P<step>\d+))?)?$"
+)
+
+
+class FaultInjected(RuntimeError):
+    """The injected failure itself (``raise`` / ``producer_die`` actions)."""
+
+    def __init__(self, clause: "FaultClause", site: str, coords: dict):
+        self.clause = clause
+        self.site = site
+        self.coords = dict(coords)
+        super().__init__(f"injected fault {clause.spec} fired at {site} {coords}")
+
+
+@dataclass(frozen=True)
+class FaultClause:
+    spec: str          # the clause text, verbatim — also the ledger key
+    action: str
+    task: int
+    epoch: Optional[int] = None   # None = wildcard
+    step: Optional[int] = None    # None = wildcard
+
+    def matches(self, site: str, coords: dict) -> bool:
+        if site not in ACTIONS[self.action]:
+            return False
+        if site == "engine.step" and self.step is None:
+            # An epoch- or task-granular kill/raise names the END of its
+            # unit: it fires at the engine.epoch site (after that epoch's
+            # checkpoint hook), never mid-epoch at the first step reached —
+            # otherwise kill@taskT.epochE would strike before epoch E's
+            # checkpoint exists and the resume could not be epoch-exact.
+            return False
+        for field in ("task", "epoch", "step"):
+            want = getattr(self, field)
+            if want is not None and coords.get(field) != want:
+                return False
+        return True
+
+
+def parse_fault_spec(spec: str) -> List[FaultClause]:
+    """Parse a ``--fault_spec`` string; raises ``ValueError`` on any bad
+    clause (a typo'd fault plan silently never firing would defeat the whole
+    point of deterministic injection)."""
+    clauses: List[FaultClause] = []
+    for raw in spec.split(","):
+        text = raw.strip()
+        if not text:
+            continue
+        m = _CLAUSE_RE.fullmatch(text)
+        if not m:
+            raise ValueError(
+                f"bad fault clause {text!r}; expected "
+                "<action>@task<T>[.epoch<E>[.step<S>]]"
+            )
+        action = m.group("action")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; options: {sorted(ACTIONS)}"
+            )
+        clauses.append(FaultClause(
+            spec=text,
+            action=action,
+            task=int(m.group("task")),
+            epoch=int(m.group("epoch")) if m.group("epoch") else None,
+            step=int(m.group("step")) if m.group("step") else None,
+        ))
+    if not clauses:
+        raise ValueError(f"fault spec {spec!r} contains no clauses")
+    return clauses
+
+
+class FaultInjector:
+    """Armed fault clauses + the durable fired-ledger.
+
+    ``fire(site, **coords)`` checks every armed clause against the site and
+    coordinates; each match is recorded (ledger first — it must survive a
+    SIGKILL — then the ``fault_injected`` telemetry record) and then executed:
+    ``kill`` SIGKILLs this process, ``raise``/``producer_die`` raise
+    :class:`FaultInjected`, ``slow_batch`` sleeps; the cooperative checkpoint
+    actions are *returned* for the call site to apply.  Clauses are one-shot.
+    """
+
+    def __init__(
+        self,
+        clauses: List[FaultClause],
+        ledger_path: Optional[str] = None,
+        sink=None,
+        slow_s: float = 0.25,
+    ):
+        self.ledger_path = ledger_path
+        self.sink = sink
+        self.slow_s = slow_s
+        spent = self._load_ledger()
+        self._armed: List[FaultClause] = []
+        for c in clauses:
+            if spent.get(c.spec, 0) > 0:
+                spent[c.spec] -= 1  # duplicate clauses spend ledger entries 1:1
+            else:
+                self._armed.append(c)
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def armed(self) -> Tuple[FaultClause, ...]:
+        return tuple(self._armed)
+
+    def fire(self, site: str, **coords) -> Tuple[str, ...]:
+        """Fire every armed clause matching ``(site, coords)``.
+
+        Returns the matched :data:`COOPERATIVE` action names for the caller
+        to apply; non-cooperative actions are performed here (and ``kill`` /
+        ``raise`` never return).
+        """
+        if not self._armed:
+            return ()
+        matched = [c for c in self._armed if c.matches(site, coords)]
+        if not matched:
+            return ()
+        cooperative: List[str] = []
+        for clause in matched:
+            self._armed.remove(clause)
+            self._record(clause, site, coords)
+            if clause.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif clause.action in ("raise", "producer_die"):
+                raise FaultInjected(clause, site, coords)
+            elif clause.action == "slow_batch":
+                time.sleep(self.slow_s)
+            else:
+                cooperative.append(clause.action)
+        return tuple(cooperative)
+
+    # ------------------------------------------------------------------ #
+
+    def _record(self, clause: FaultClause, site: str, coords: dict) -> None:
+        # Ledger strictly before the action: a SIGKILL between the two writes
+        # must lose the telemetry record, never the disarm.
+        if self.ledger_path:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(self.ledger_path)), exist_ok=True
+            )
+            with open(self.ledger_path, "a") as f:
+                f.write(json.dumps({
+                    "spec": clause.spec, "site": site,
+                    "ts": round(time.time(), 3), "pid": os.getpid(), **coords,
+                }) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        if self.sink is not None:
+            self.sink.log(
+                "fault_injected", site=site, action=clause.action,
+                spec=clause.spec,
+                **{k: v for k, v in coords.items() if v is not None},
+            )
+        print(f"| FAULT INJECTED: {clause.spec} at {site} {coords}")
+
+    def _load_ledger(self) -> Dict[str, int]:
+        spent: Dict[str, int] = {}
+        if not self.ledger_path or not os.path.exists(self.ledger_path):
+            return spent
+        with open(self.ledger_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn trailing line of a killed process
+                spec = rec.get("spec")
+                if spec:
+                    spent[spec] = spent.get(spec, 0) + 1
+        return spent
+
+
+def injector_from(
+    spec: Optional[str],
+    ledger_path: Optional[str] = None,
+    sink=None,
+) -> Optional[FaultInjector]:
+    """The trainer's entry point: ``None`` when no spec is configured, so the
+    hot paths pay exactly one ``is not None`` check."""
+    if not spec:
+        return None
+    return FaultInjector(parse_fault_spec(spec), ledger_path=ledger_path, sink=sink)

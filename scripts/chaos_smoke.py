@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""CPU chaos smoke: SIGKILL mid-task, supervise back, match the twin exactly.
+
+The acceptance proof for the fault-injection + epoch-resume + supervisor
+stack, end to end with *real processes* and a *real* SIGKILL (not an
+exception a test harness can intercept):
+
+1. Run a tiny 2-task synthetic protocol to completion — the fault-free twin.
+2. Run the same protocol with ``--fault_spec kill@task1.epoch2`` and
+   ``--epoch_ckpt_every 1`` under ``scripts/supervise.py``: the trainer
+   SIGKILLs itself right after task 1's second epoch lands its checkpoint;
+   the supervisor relaunches it with ``--resume``; the fault ledger keeps the
+   relaunch from re-firing; the relaunch restores the *epoch* checkpoint and
+   finishes the protocol.
+3. Assert from the chaos run's JSONL evidence that the kill actually fired
+   (``fault_injected``), that the resume was epoch-granular
+   (``resume.kind == "epoch"`` at task 1, epoch 2 — not a task-boundary
+   restart), and that the final accuracy matrix, acc1 trajectory and
+   alignment γ are **bit-identical** to the twin's.
+
+Exit 0 on exact match, 1 otherwise, one JSON line either way.
+Used by ``scripts/ci.sh``; runnable standalone from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Shapes chosen to reuse the compiled programs the tier-1 suite and
+# prefetch_smoke already put in tests/.jax_cache (same model, batch, path).
+_PROTO = [
+    "--platform", "cpu",
+    "--data_set", "synthetic10",
+    "--num_bases", "0",
+    "--increment", "5",
+    "--backbone", "resnet20",
+    "--batch_size", "16",
+    "--num_epochs", "3",
+    "--eval_every_epoch", "100",
+    "--memory_size", "40",
+    "--lr", "0.05",
+    "--aa", "none",
+    "--color_jitter", "0.0",
+    "--seed", "7",
+    "--no_fused_epochs",
+    "--compile_cache", os.path.join(_REPO, "tests", ".jax_cache"),
+]
+
+
+def _records(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _last(records, kind):
+    hits = [r for r in records if r.get("type") == kind]
+    return hits[-1] if hits else None
+
+
+def _task_gammas(records):
+    gam = {}
+    for r in records:
+        if r.get("type") == "task":
+            gam[r["task_id"]] = r.get("gamma")  # last record per task wins
+    return gam
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_") as tmp:
+        twin_log = os.path.join(tmp, "twin.jsonl")
+        chaos_log = os.path.join(tmp, "chaos.jsonl")
+        ckpt_dir = os.path.join(tmp, "ckpt")
+
+        twin_cmd = [sys.executable, os.path.join(_REPO, "train.py"),
+                    *_PROTO, "--log_file", twin_log]
+        twin = subprocess.run(twin_cmd, cwd=_REPO, timeout=900)
+        if twin.returncode != 0:
+            print(json.dumps({"metric": "chaos_smoke", "ok": False,
+                              "reason": f"twin run failed rc={twin.returncode}"}))
+            return 1
+
+        chaos_cmd = [
+            sys.executable, os.path.join(_REPO, "scripts", "supervise.py"),
+            "--backoff_base", "0.1", "--backoff_max", "1",
+            "--max_failures", "3", "--failure_window", "120",
+            "--",
+            sys.executable, os.path.join(_REPO, "train.py"), *_PROTO,
+            "--log_file", chaos_log,
+            "--ckpt_dir", ckpt_dir,
+            "--epoch_ckpt_every", "1",
+            "--fault_spec", "kill@task1.epoch2",
+        ]
+        chaos = subprocess.run(chaos_cmd, cwd=_REPO, timeout=900)
+
+        failures = []
+        if chaos.returncode != 0:
+            failures.append(f"supervisor exited rc={chaos.returncode}")
+        twin_recs = _records(twin_log)
+        chaos_recs = _records(chaos_log) if os.path.exists(chaos_log) else []
+
+        fault = _last(chaos_recs, "fault_injected")
+        if not (fault and fault.get("action") == "kill"
+                and fault.get("task") == 1 and fault.get("epoch") == 2):
+            failures.append(f"kill fault did not fire as specified: {fault}")
+        resume = _last(chaos_recs, "resume")
+        if not (resume and resume.get("kind") == "epoch"
+                and resume.get("start_task") == 1
+                and resume.get("start_epoch") == 2):
+            failures.append(
+                f"resume was not epoch-granular at task1/epoch2: {resume}")
+
+        twin_final = _last(twin_recs, "final")
+        chaos_final = _last(chaos_recs, "final")
+        if twin_final is None or chaos_final is None:
+            failures.append("a run produced no final record")
+        else:
+            for key in ("acc1s", "avg_incremental_acc1"):
+                if twin_final.get(key) != chaos_final.get(key):
+                    failures.append(
+                        f"{key} differs: twin={twin_final.get(key)} "
+                        f"chaos={chaos_final.get(key)}")
+        twin_task = _last(twin_recs, "task")
+        chaos_task = _last(chaos_recs, "task")
+        twin_gam = _task_gammas(twin_recs)
+        chaos_gam = _task_gammas(chaos_recs)
+        if twin_gam != chaos_gam:
+            failures.append(f"gamma differs: twin={twin_gam} chaos={chaos_gam}")
+        if (twin_task and chaos_task
+                and twin_task.get("acc_per_task") != chaos_task.get("acc_per_task")):
+            failures.append(
+                f"final matrix row differs: twin={twin_task.get('acc_per_task')} "
+                f"chaos={chaos_task.get('acc_per_task')}")
+
+        print(json.dumps({
+            "metric": "chaos_smoke",
+            "ok": not failures,
+            "failures": failures,
+            "twin_acc1s": (twin_final or {}).get("acc1s"),
+            "chaos_acc1s": (chaos_final or {}).get("acc1s"),
+            "resume": resume,
+            "fault": fault,
+        }))
+        return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

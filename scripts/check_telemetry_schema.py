@@ -31,7 +31,34 @@ NUM = (int, float)
 # whatever meters the train step emits).
 SCHEMA = {
     "run": ({"data_set": str, "backbone": str, "seed": NUM}, {}, "any"),
-    "resume": ({"start_task": NUM}, {}, None),
+    "resume": (
+        {"start_task": NUM},
+        {"start_epoch": NUM, "path": str, "kind": str},
+        None,
+    ),
+    # Fault injection (faults/injector.py): one record per fired clause.
+    "fault_injected": (
+        {"site": str, "action": str, "spec": str},
+        {"task": NUM, "epoch": NUM, "step": NUM},
+        None,
+    ),
+    # Prefetch producer death -> synchronous-path degradation
+    # (data/prefetch.py on_degrade hook, wired in engine/loop.py).
+    "prefetch_degraded": (
+        {"where": str, "error": str},
+        {"task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    # A checkpoint save failed transiently; the run continued (durability
+    # gap, logged so the evidence trail shows it).
+    "ckpt_save_error": (
+        {"error": str},
+        {"path": str, "task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    # Restore skipped an invalid (truncated/corrupt) checkpoint and fell
+    # back to the next-newest valid candidate.
+    "ckpt_fallback": ({"skipped": str, "reason": str}, {}, None),
     "epoch": (
         {"task_id": NUM, "epoch": NUM, "lr": NUM},
         {

@@ -35,11 +35,24 @@ case "$ONLY" in
   *) echo "ONLY must be 'b0', 'b50' or empty, got '$ONLY'" >&2; exit 2 ;;
 esac
 EXTRA_ARGS=${EXTRA_ARGS:-}  # e.g. "--compute_dtype bfloat16"
+# Fault tolerance (supervised runs, see scripts/supervise.py): CKPT_DIR
+# gives each protocol its own checkpoint root (they must not share one —
+# the b50 run would otherwise resume from the b0 run's checkpoints), and a
+# trailing --resume argument (what the supervisor appends on relaunch) is
+# forwarded to both train.py invocations so a relaunch continues from the
+# newest valid task/epoch checkpoint.  A protocol that already finished
+# resumes past its last task and just re-renders its summary.
+CKPT_DIR=${CKPT_DIR:-}
+CKPT_EVERY=${CKPT_EVERY:-10}
+RESUME_ARG=""
+if [ "${1:-}" = "--resume" ]; then RESUME_ARG="--resume"; fi
 
 if [ "$ONLY" != "b50" ]; then
 python train.py --data_set "$DATASET" --num_bases 0 --increment 10 \
   --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
   --memory_size "$MEMORY" --seed "$SEED" $PLATFORM_ARGS $EXTRA_ARGS \
+  ${CKPT_DIR:+--ckpt_dir "$CKPT_DIR/b0" --epoch_ckpt_every "$CKPT_EVERY"} \
+  $RESUME_ARG \
   --log_file "experiments/b0_inc10_${DATASET}${SUFFIX}.jsonl"
 fi
 
@@ -47,6 +60,8 @@ if [ "$ONLY" != "b0" ]; then
 python train.py --data_set "$DATASET" --num_bases 50 --increment 10 \
   --backbone resnet32 --batch_size "$BATCH" --num_epochs "$EPOCHS" --aa "$AA" \
   --memory_size "$MEMORY" --seed "$SEED" $PLATFORM_ARGS $EXTRA_ARGS \
+  ${CKPT_DIR:+--ckpt_dir "$CKPT_DIR/b50" --epoch_ckpt_every "$CKPT_EVERY"} \
+  $RESUME_ARG \
   --log_file "experiments/b50_inc10_${DATASET}${SUFFIX}.jsonl"
 fi
 

@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Supervised auto-restart driver for long training runs.
+
+On TPU pods the trainer *will* die — preemption, OOM, a flaky host — and the
+recovery loop (relaunch with ``--resume``, which picks the newest valid task
+or epoch checkpoint) should not depend on a human watching the terminal.
+This supervisor owns that loop, subsuming the relaunch half of
+``scripts/tpu_watchdog.sh`` (whose probing half already reads the heartbeat
+file this supervisor also watches):
+
+* Launches the trainer command (everything after ``--``) in its own process
+  group and waits.
+* Exit 0 ⇒ done, supervisor exits 0.
+* Crash (non-zero exit, or a signal like the SIGKILL a preemption or an
+  injected ``kill@...`` fault delivers) ⇒ relaunch under exponential backoff,
+  appending ``--resume`` (once) so the child continues from its newest valid
+  checkpoint.
+* Hang (heartbeat file stale beyond ``--max_age`` while the child still
+  lives) ⇒ kill the whole process group, then treat it as a crash.
+* Crash-loop breaker: more than ``--max_failures`` failures inside a sliding
+  ``--failure_window`` ⇒ stop relaunching, report, exit 2.  An uptime longer
+  than the window resets the count — a run that trains for an hour between
+  two unrelated preemptions is not a crash loop.
+
+Stdlib-only (like ``analysis/`` and ``faults/``): the supervisor must never
+import jax — it outlives trainer processes whose jax runtime is wedged.
+
+Example::
+
+    python scripts/supervise.py --heartbeat /tmp/run/heartbeat.json \
+        --max_age 120 -- \
+        python train.py --ckpt_dir /tmp/run/ckpt --epoch_ckpt_every 5 \
+            --telemetry_dir /tmp/run ...
+
+Every supervisor decision is emitted as a JSON line on stdout (and to
+``--log`` when given) so a fleet controller can tail it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _parse_args(argv: List[str]):
+    p = argparse.ArgumentParser(
+        description="launch, watch and auto-restart a training command",
+    )
+    p.add_argument("--heartbeat", default=None,
+                   help="heartbeat JSON the child maintains "
+                   "(--heartbeat_path / <telemetry_dir>/heartbeat.json)")
+    p.add_argument("--max_age", type=float, default=0.0,
+                   help="seconds of heartbeat staleness that counts as a "
+                   "hang (0 = liveness watching off; exit codes only)")
+    p.add_argument("--poll", type=float, default=2.0,
+                   help="child poll / heartbeat check cadence in seconds")
+    p.add_argument("--grace", type=float, default=30.0,
+                   help="seconds after launch before staleness checks start "
+                   "(process startup + first heartbeat write)")
+    p.add_argument("--backoff_base", type=float, default=1.0,
+                   help="first relaunch delay; doubles per consecutive "
+                   "failure up to --backoff_max")
+    p.add_argument("--backoff_max", type=float, default=300.0)
+    p.add_argument("--max_failures", type=int, default=5,
+                   help="failures within --failure_window that trip the "
+                   "crash-loop breaker (exit 2)")
+    p.add_argument("--failure_window", type=float, default=3600.0,
+                   help="sliding window for the breaker; uptime beyond it "
+                   "also resets the consecutive-failure backoff")
+    p.add_argument("--resume_flag", default="--resume",
+                   help="flag appended (once) to the command after the "
+                   "first crash so relaunches continue from the newest "
+                   "checkpoint; '' disables")
+    p.add_argument("--log", default=None,
+                   help="also append the JSON event lines here")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="-- then the training command")
+    args = p.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no training command given (everything after --)")
+    args.command = cmd
+    return args
+
+
+class Supervisor:
+    def __init__(self, args):
+        self.args = args
+        self.failures: List[float] = []  # monotonic timestamps, sliding window
+
+    # ------------------------------------------------------------------ #
+
+    def _event(self, kind: str, **fields) -> None:
+        line = json.dumps({"event": kind, "ts": round(time.time(), 3), **fields})
+        print(line, flush=True)
+        if self.args.log:
+            with open(self.args.log, "a") as f:
+                f.write(line + "\n")
+
+    def _heartbeat_stale(self) -> Optional[float]:
+        """Age in seconds when the heartbeat is stale, else None."""
+        hb, max_age = self.args.heartbeat, self.args.max_age
+        if not hb or max_age <= 0:
+            return None
+        try:
+            age = time.time() - os.stat(hb).st_mtime
+        except OSError:
+            return None  # not written yet; the grace period covers startup
+        return age if age > max_age else None
+
+    def _kill_group(self, proc: subprocess.Popen) -> None:
+        """SIGTERM then SIGKILL the child's whole process group (the trainer
+        may have its own children: compile workers, profilers)."""
+        for sig in (signal.SIGTERM, signal.SIGKILL):
+            try:
+                os.killpg(proc.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                return
+            try:
+                proc.wait(timeout=10.0)
+                return
+            except subprocess.TimeoutExpired:
+                continue
+
+    def _run_once(self, cmd: List[str]):
+        """Launch and babysit one child; returns (returncode, uptime_s)."""
+        start = time.monotonic()
+        proc = subprocess.Popen(cmd, start_new_session=True)
+        self._event("launch", pid=proc.pid, cmd=cmd)
+        hung = False
+        while True:
+            try:
+                rc = proc.wait(timeout=self.args.poll)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            if time.monotonic() - start < self.args.grace:
+                continue
+            age = self._heartbeat_stale()
+            if age is not None:
+                self._event("hang", pid=proc.pid,
+                            heartbeat_age_s=round(age, 1))
+                self._kill_group(proc)
+                hung = True
+                rc = proc.returncode if proc.returncode is not None else -9
+                break
+        uptime = time.monotonic() - start
+        self._event("exit", pid=proc.pid, returncode=rc, hung=hung,
+                    uptime_s=round(uptime, 1))
+        return rc, uptime
+
+    # ------------------------------------------------------------------ #
+
+    def run(self) -> int:
+        args = self.args
+        cmd = list(args.command)
+        attempt = 0
+        consecutive = 0
+        while True:
+            attempt += 1
+            rc, uptime = self._run_once(cmd)
+            if rc == 0:
+                self._event("done", attempts=attempt)
+                return 0
+            now = time.monotonic()
+            if uptime > args.failure_window:
+                # A long-lived child that eventually died is a fresh
+                # incident, not part of a crash loop.
+                self.failures.clear()
+                consecutive = 0
+            self.failures.append(now)
+            self.failures = [t for t in self.failures
+                             if now - t <= args.failure_window]
+            consecutive += 1
+            if len(self.failures) > args.max_failures:
+                self._event(
+                    "breaker", failures=len(self.failures),
+                    window_s=args.failure_window,
+                    message="crash loop: relaunching stopped; inspect the "
+                    "run log / last checkpoint before restarting",
+                )
+                return 2
+            if args.resume_flag and args.resume_flag not in cmd:
+                cmd = cmd + [args.resume_flag]
+            delay = min(args.backoff_base * (2 ** (consecutive - 1)),
+                        args.backoff_max)
+            self._event("relaunch", attempt=attempt + 1,
+                        backoff_s=round(delay, 2),
+                        failures_in_window=len(self.failures))
+            time.sleep(delay)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    return Supervisor(args).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,11 @@
 # the chip — liveness is logged from the beat (step/task/epoch) and the
 # blind probe is skipped entirely.
 #
+# Division of labour: this script probes and captures; *relaunching* a dead
+# or hung trainer is scripts/supervise.py's job — the protocol runs below go
+# through it, so a preemption mid-run costs at most the epochs since the
+# last checkpoint instead of the whole run.
+#
 # Evidence-preservation: bench/profile output is written to a temp file and
 # only moved into experiments/ on rc=0, so a timed-out or crashed capture
 # never overwrites previously captured evidence with an empty/partial file.
@@ -96,9 +101,20 @@ print('tpu alive')
     # MEMORY=256 + synthetic_hard128 = the dynamics-valid regime (the
     # default 2000-exemplar budget nearly replays the 6400-image synthetic
     # stream, so no forgetting could show — see run_protocol.sh).
-    log "starting 140-epoch TPU protocol runs"
+    #
+    # Launched under scripts/supervise.py, which owns the relaunch half of
+    # fault tolerance (this watchdog only probes/captures): a preempted or
+    # hung trainer is killed on heartbeat staleness and relaunched with
+    # --resume, continuing from the newest valid task/epoch checkpoint
+    # (CKPT_DIR below; run_protocol.sh forwards the resume flag).
+    log "starting 140-epoch TPU protocol runs (supervised)"
     EPOCHS=140 SUFFIX=_tpu140 DATASET=synthetic_hard128 MEMORY=256 \
-      AA=rand-m9-mstd0.5-inc1 timeout 10800 bash scripts/run_protocol.sh \
+      AA=rand-m9-mstd0.5-inc1 CKPT_DIR=experiments/ckpt_tpu140 \
+      EXTRA_ARGS="--telemetry_dir experiments ${EXTRA_ARGS:-}" \
+      timeout 10800 python scripts/supervise.py \
+        --heartbeat "$HEARTBEAT" --max_age "$HB_MAX_AGE" --grace 300 \
+        --log experiments/supervise_tpu140.log \
+        -- bash scripts/run_protocol.sh \
       > /tmp/protocol_tpu.log 2>&1 || log "TPU protocol rc=$?"
     log "watchdog done"
     exit 0

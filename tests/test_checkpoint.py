@@ -102,9 +102,14 @@ def test_resume_without_checkpoint_is_fresh(devices8, tmp_path):
 
 def test_incomplete_orbax_checkpoint_ignored(tmp_path):
     """An orbax dir without its metadata sidecar is not a resumable
-    checkpoint (crash window between the two writes)."""
+    checkpoint (crash window between the two writes), and a torn/corrupt
+    sidecar is treated the same as a missing one."""
+    import pickle
+
     d = tmp_path / "ck"
     (d / "task_003.orbax").mkdir(parents=True)
     assert latest_task_checkpoint(str(d)) is None
-    (d / "task_003.orbax.meta").write_bytes(b"x")
+    (d / "task_003.orbax.meta").write_bytes(b"x")  # torn write, not a pickle
+    assert latest_task_checkpoint(str(d)) is None
+    (d / "task_003.orbax.meta").write_bytes(pickle.dumps({"task_id": 3}))
     assert latest_task_checkpoint(str(d)).endswith("task_003.orbax")

@@ -300,6 +300,95 @@ def test_jl301_locked_writes_are_clean(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# JL302 — swallowed broad exceptions
+# --------------------------------------------------------------------------- #
+
+
+def test_jl302_bare_except_pass(tmp_path):
+    findings = run_lint(tmp_path, """
+        def save(path, data):
+            try:
+                open(path, "w").write(data)
+            except:
+                pass
+        """)
+    assert rules_of(findings) == ["JL302"]
+    (f,) = findings
+    assert "bare except" in f.message
+
+
+def test_jl302_broad_except_swallowing_result(tmp_path):
+    findings = run_lint(tmp_path, """
+        def probe(dev):
+            try:
+                return dev.memory_stats()
+            except Exception:
+                return None
+        """)
+    assert rules_of(findings) == ["JL302"]
+
+
+def test_jl302_tuple_with_broad_member(tmp_path):
+    findings = run_lint(tmp_path, """
+        def probe(dev):
+            try:
+                return dev.memory_stats()
+            except (OSError, BaseException):
+                return None
+        """)
+    assert rules_of(findings) == ["JL302"]
+
+
+def test_jl302_narrow_except_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import os
+
+        def cleanup(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        """)
+    assert findings == []
+
+
+def test_jl302_reraise_read_or_report_are_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import logging
+
+        def a(fn):
+            try:
+                fn()
+            except Exception:
+                raise            # re-raised: nothing swallowed
+
+        def b(fn):
+            try:
+                fn()
+            except Exception as e:
+                return repr(e)   # the error is read
+
+        def c(fn):
+            try:
+                fn()
+            except Exception:
+                logging.warning("fn failed")  # reported
+        """)
+    assert findings == []
+
+
+def test_jl302_suppression_comment(tmp_path):
+    findings = run_lint(tmp_path, """
+        def teardown(res):
+            try:
+                res.close()
+            except Exception:  # jaxlint: disable=JL302 -- interpreter exit
+                pass
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
 # suppressions / baseline / JL000
 # --------------------------------------------------------------------------- #
 
