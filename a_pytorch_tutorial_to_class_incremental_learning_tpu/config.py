@@ -177,6 +177,9 @@ class CilConfig:
     heartbeat_path: Optional[str] = None  # liveness JSON consumed by
     # scripts/tpu_watchdog.sh (atomic rewrite on a cadence)
     heartbeat_interval_s: float = 15.0
+    flight_events: int = 256  # flight-recorder ring capacity (0 = off);
+    # the last N telemetry events are dumped to
+    # <telemetry_dir>/flight_{proc}.json on every death path
 
     # ------------------------------------------------------------------ #
 
@@ -315,6 +318,10 @@ def get_args_parser() -> argparse.ArgumentParser:
                    type=float,
                    help="heartbeat cadence; the file is guaranteed fresher "
                    "than 2x this during a live run")
+    p.add_argument("--flight_events", default=d.flight_events, type=int,
+                   help="flight-recorder ring capacity: the last N telemetry "
+                   "events dumped to <telemetry_dir>/flight_{proc}.json on "
+                   "crash/SIGTERM/exit for post-mortem forensics (0 = off)")
     p.add_argument("--bn_group_size", default=0, type=int,
                    help="BatchNorm statistics group size (0 = global batch; "
                    "128 = reference per-GPU parity)")
@@ -402,4 +409,5 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         telemetry_dir=args.telemetry_dir,
         heartbeat_path=args.heartbeat_path,
         heartbeat_interval_s=args.heartbeat_interval_s,
+        flight_events=args.flight_events,
     )

@@ -102,20 +102,39 @@ class CilTrainer:
             heartbeat_path=config.heartbeat_path,
             heartbeat_interval_s=config.heartbeat_interval_s,
             sink=self.jsonl,
+            flight_events=config.flight_events,
         )
+        # With a flight recorder active the facade wrapped the logger in a
+        # FlightSink tee; rebind so every engine record (epoch/task/fault)
+        # also lands in the crash-forensics ring.
+        self.jsonl = self.telemetry.sink
         # Deterministic fault injection (--fault_spec; faults/injector.py).
         # None when unset, so every hot-path site pays one identity check.
         # The ledger defaults next to the checkpoints: a supervised relaunch
         # of a killed run parses the same spec but finds the clause spent.
         self.faults = None
         if config.fault_spec:
-            from faults import injector_from
+            from faults import injector_from, rotate_ledger
 
             ledger = config.fault_state
             if ledger is None and config.ckpt_dir:
                 ledger = os.path.join(config.ckpt_dir, "fault_ledger.jsonl")
+            if not config.resume:
+                # Fresh soak iteration: archive the previous run's spent
+                # ledger so the spec re-arms (resumed runs keep it — the
+                # spent ledger is the crash-loop guard).
+                archived = rotate_ledger(ledger)
+                if archived:
+                    self.jsonl.log(
+                        "fault_ledger_rotated", path=ledger, archived=archived
+                    )
+            on_fatal = (
+                self.telemetry.flight.fatal_dump
+                if self.telemetry.flight is not None else None
+            )
             self.faults = injector_from(
-                config.fault_spec, ledger_path=ledger, sink=self.jsonl
+                config.fault_spec, ledger_path=ledger, sink=self.jsonl,
+                on_fatal=on_fatal,
             )
         with self.telemetry.span("build_scenario"):
             self.scenario_train, self.nb_classes = build_scenario(

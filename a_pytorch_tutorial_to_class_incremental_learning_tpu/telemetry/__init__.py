@@ -27,6 +27,7 @@ from .cil_metrics import (  # noqa: F401
     per_task_forgetting,
 )
 from .counters import RecompileMonitor, StallClock, clocked, hbm_stats  # noqa: F401
+from .flight import FlightRecorder, FlightSink  # noqa: F401
 from .heartbeat import Heartbeat, read_heartbeat  # noqa: F401
 from .spans import SpanTracer, coverage, load_spans  # noqa: F401
 
@@ -35,12 +36,21 @@ class Telemetry:
     """One handle over the telemetry subsystem, built from config flags.
 
     * ``telemetry_dir`` — spans land in ``<dir>/spans.jsonl`` (plus a
-      Chrome-trace export at close); default heartbeat location.
+      Chrome-trace export at close); default heartbeat location; the flight
+      recorder dumps to ``<dir>/flight_{process_index}.json``.
     * ``heartbeat_path`` — overrides the heartbeat file location (can be
       enabled without a telemetry dir, e.g. just for the watchdog).
     * ``sink`` — where counter and metric *records* go; the engine passes
       its experiment ``JsonlLogger`` so one JSONL stream carries the whole
-      run (sink unification).
+      run (sink unification).  With a telemetry dir the facade wraps it in a
+      :class:`FlightSink`, so every record also lands in the crash-forensics
+      ring — the engine reads the wrapped sink back from ``self.sink``.
+    * ``flight_events`` — ring capacity (``--flight_events``; 0 disables).
+
+    Every sub-component is process-aware: process identity is resolved once
+    here (``jax.process_index()`` when distributed, 0 otherwise) and pushed
+    down, so a pod writes one stream *per process* instead of silencing all
+    but process 0 (the pre-PR 6 behaviour).
     """
 
     def __init__(
@@ -49,17 +59,51 @@ class Telemetry:
         heartbeat_path: Optional[str] = None,
         heartbeat_interval_s: float = 15.0,
         sink: Optional[Sink] = None,
+        flight_events: int = 256,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
     ):
         self.dir = telemetry_dir
         self.sink = sink or NullSink()
+        self.flight: Optional[FlightRecorder] = None
+        if process_index is None and (telemetry_dir or heartbeat_path):
+            import jax
+
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        process_index = int(process_index or 0)
+        process_count = int(process_count or 1)
         if telemetry_dir:
             os.makedirs(telemetry_dir, exist_ok=True)
             if heartbeat_path is None:
                 heartbeat_path = os.path.join(telemetry_dir, "heartbeat.json")
+            if flight_events > 0:
+                import socket
+
+                self.flight = FlightRecorder(
+                    os.path.join(
+                        telemetry_dir, f"flight_{process_index}.json"
+                    ),
+                    capacity=flight_events,
+                    process_index=process_index,
+                    process_count=process_count,
+                    host_id=socket.gethostname(),
+                )
+                self.flight.install()
+                self.sink = FlightSink(self.sink, self.flight)
         self.spans = SpanTracer(
-            os.path.join(telemetry_dir, "spans.jsonl") if telemetry_dir else None
+            os.path.join(telemetry_dir, "spans.jsonl") if telemetry_dir else None,
+            process_index=process_index,
+            process_count=process_count,
+            flight=self.flight,
         )
-        self.heartbeat = Heartbeat(heartbeat_path, heartbeat_interval_s)
+        self.heartbeat = Heartbeat(
+            heartbeat_path,
+            heartbeat_interval_s,
+            process_index=process_index,
+            process_count=process_count,
+            flight=self.flight,
+        )
         self.recompiles = RecompileMonitor(self.sink)
         self.matrix = AccuracyMatrix()
 
@@ -78,10 +122,15 @@ class Telemetry:
             self.sink.log("hbm", devices=stats, **attrs)
 
     def close(self) -> None:
-        """End of run: stop the heartbeat thread (final beat) and export the
-        Perfetto-compatible trace next to the span JSONL."""
+        """End of run: stop the heartbeat thread (final beat), export the
+        Perfetto-compatible trace next to the span JSONL, and leave a final
+        flight dump (then unhook the death paths, so tests that build many
+        Telemetry objects in one process don't stack handlers)."""
         self.heartbeat.stop()
         if self.spans.enabled:
-            self.spans.export_chrome_trace(
-                os.path.join(self.dir, "trace.json")
-            )
+            name = "trace.json" if not self.spans.process_index else \
+                f"trace_p{self.spans.process_index}.json"
+            self.spans.export_chrome_trace(os.path.join(self.dir, name))
+        if self.flight is not None:
+            self.flight.dump("close")
+            self.flight.uninstall()

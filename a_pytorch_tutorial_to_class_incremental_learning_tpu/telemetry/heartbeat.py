@@ -9,9 +9,10 @@ process itself atomically rewriting one small JSON file on a cadence —
 
 Contract (consumed by the watchdog and documented in README):
 
-* the file is a single JSON object: ``{"type": "heartbeat", "ts", "seq",
-  "pid", "step", "task", "epoch", "phase", "last_step_ms"}``; ``ts`` is
-  wall-clock seconds, ``seq`` strictly monotonic;
+* the file is a single JSON object: ``{"type": "heartbeat", "ts", "mono",
+  "seq", "pid", "process_index", "step", "task", "epoch", "phase",
+  "last_step_ms"}``; ``ts`` is wall-clock seconds, ``mono`` the monotonic
+  clock at the same instant, ``seq`` strictly monotonic;
 * it is replaced atomically (write temp + ``os.replace`` on the same
   filesystem), so a reader never sees a partial write;
 * during a live run its age never exceeds ~2x the configured interval.
@@ -37,8 +38,15 @@ class Heartbeat:
     fields and writes only when the interval elapsed).  ``start()`` spawns a
     daemon thread that keeps writing the latest state every ``interval_s/2``
     even while the loop is stuck inside one long call; ``stop()`` joins it
-    and writes a final beat.  Disabled (``path=None`` or non-zero process)
-    every method is a no-op.
+    and writes a final beat.  Disabled (``path=None``) every method is a
+    no-op.  Every JAX process beats into its *own* file (process 0 keeps the
+    legacy name, process *i* gets ``heartbeat_p{i}.json``), each beat tagged
+    with ``process_index`` plus a monotonic-clock ``mono`` field — the
+    ``(ts, mono)`` pair is what ``scripts/report_run.py`` uses to align
+    clock-skewed per-process streams.  With a
+    :class:`~.flight.FlightRecorder` attached, every beat also lands in the
+    flight ring and triggers a periodic flight dump, so even an uncatchable
+    death leaves a dump at most half an interval stale.
     """
 
     def __init__(
@@ -46,13 +54,21 @@ class Heartbeat:
         path: Optional[str],
         interval_s: float = 15.0,
         process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        flight=None,
     ):
         if path is not None and process_index is None:
             import jax
 
             process_index = jax.process_index()
-        self.enabled = bool(path) and not process_index
-        self.path = path if self.enabled else None
+            process_count = jax.process_count()
+        from ..utils.logging import process_suffixed
+
+        self.process_index = int(process_index or 0)
+        self.process_count = int(process_count or 1)
+        self.enabled = bool(path)
+        self.path = process_suffixed(path, self.process_index) if path else None
+        self.flight = flight
         self.interval_s = float(interval_s)
         self._seq = 0
         self._state = {}
@@ -107,8 +123,13 @@ class Heartbeat:
             payload = {
                 "type": "heartbeat",
                 "ts": round(time.time(), 3),
+                # Monotonic stamp beside the wall stamp: (ts - mono) is a
+                # per-process clock offset, so a merged report can align
+                # streams whose wall clocks disagree (NTP skew across hosts).
+                "mono": round(time.monotonic(), 3),
                 "seq": self._seq,
                 "pid": os.getpid(),
+                "process_index": self.process_index,
                 **self._state,
             }
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -126,6 +147,9 @@ class Heartbeat:
             # decide cadence (jaxlint JL301).
             with self._lock:
                 self._last_write = time.monotonic()
+            if self.flight is not None:
+                self.flight.record(payload)
+                self.flight.dump("heartbeat")
         except OSError:
             # A full disk must not kill training; staleness is the signal.
             try:

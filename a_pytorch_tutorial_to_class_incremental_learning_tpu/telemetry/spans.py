@@ -33,17 +33,34 @@ from typing import Iterator, List, Optional
 class SpanTracer:
     """Context-manager span API writing ``span`` records to a JSONL file.
 
-    Disabled (``path=None``) the tracer is a pure no-op; non-zero JAX
-    processes are also silenced so a pod writes one span file, not N.
+    Disabled (``path=None``) the tracer is a pure no-op.  Every JAX process
+    traces: process 0 keeps the legacy ``spans.jsonl`` name, process *i*
+    writes ``spans_p{i}.jsonl`` (``utils.logging.process_suffixed``), and
+    each record carries ``process_index`` so a merged fleet report can tell
+    the streams apart.  When a :class:`~.flight.FlightRecorder` is attached,
+    span opens/closes feed its open-span stack — the "what was the host doing
+    at death" answer a SIGKILL'd process cannot write itself.
     """
 
-    def __init__(self, path: Optional[str], process_index: Optional[int] = None):
+    def __init__(
+        self,
+        path: Optional[str],
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        flight=None,
+    ):
         if path is not None and process_index is None:
             import jax
 
             process_index = jax.process_index()
-        self.enabled = bool(path) and not process_index
-        self.path = path if self.enabled else None
+            process_count = jax.process_count()
+        from ..utils.logging import process_suffixed
+
+        self.process_index = int(process_index or 0)
+        self.process_count = int(process_count or 1)
+        self.enabled = bool(path)
+        self.path = process_suffixed(path, self.process_index) if path else None
+        self.flight = flight
         self._stack: List[int] = []
         self._next_id = 0
         self.completed: List[dict] = []  # in-memory copy for export/coverage
@@ -66,6 +83,8 @@ class SpanTracer:
         parent = self._stack[-1] if self._stack else None
         depth = len(self._stack)
         self._stack.append(span_id)
+        if self.flight is not None:
+            self.flight.span_open(name, span_id, depth, **attrs)
         t0 = time.perf_counter()
         try:
             # Compose with the device profiler: when a jax.profiler.trace is
@@ -83,11 +102,15 @@ class SpanTracer:
                 "depth": depth,
                 "ts": round(self._wall0 + t0, 6),
                 "dur_s": round(t1 - t0, 6),
+                "process_index": self.process_index,
                 **attrs,
             }
             self.completed.append(rec)
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
+            if self.flight is not None:
+                self.flight.span_close(span_id)
+                self.flight.record(rec)
 
     # ------------------------------------------------------------------ #
     # Analysis / export

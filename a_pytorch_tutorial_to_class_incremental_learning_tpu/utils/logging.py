@@ -120,30 +120,63 @@ class NullSink(Sink):
         pass
 
 
+def process_suffixed(path: str | None, process_index: int | None) -> str | None:
+    """Per-process sibling of ``path``: process 0 keeps the legacy name
+    (``run.jsonl``), process *i* > 0 writes ``run_p{i}.jsonl`` — the naming
+    contract ``scripts/report_run.py`` uses to merge a fleet's streams and
+    ``scripts/supervise.py``/``tpu_watchdog.sh`` use to probe every host."""
+    if not path or not process_index:
+        return path
+    import os
+
+    root, ext = os.path.splitext(path)
+    return f"{root}_p{process_index}{ext}"
+
+
 class JsonlLogger(Sink):
     """Structured experiment log: one JSON object per line.
 
     The reference's only output channel is rank-0 stdout (SURVEY.md §5
     "stdout only — no files, no structured logs"); this adds a
     machine-readable record (epoch metrics, per-task accuracies, gamma,
-    timings) written by process 0.  Disabled when ``path`` is falsy.
+    timings).  Every process writes — each to its *own* per-process file
+    (see :func:`process_suffixed`) — and every record is tagged with
+    ``process_index``/``process_count``/``host_id`` so a merged multi-host
+    report can attribute each line.  Disabled when ``path`` is falsy.
+    ``process_index``/``process_count`` default from ``jax.process_index()``
+    when distributed (0/1 otherwise); tests fake them to simulate a fleet.
     """
 
-    def __init__(self, path: str | None, append: bool = False):
-        self.path = path
-        if path:
-            import os
-
+    def __init__(
+        self,
+        path: str | None,
+        append: bool = False,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        if path and process_index is None:
             import jax
 
-            # Only the writing process touches the filesystem: a late-starting
-            # non-zero host must neither truncate records already written by
-            # process 0 nor require a writable log directory.
-            if jax.process_index() != 0:
-                return
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        self.process_index = int(process_index or 0)
+        self.process_count = int(process_count or 1)
+        self.path = process_suffixed(path, self.process_index)
+        self._meta = {}
+        if self.path:
+            import os
+            import socket
+
+            self._meta = {
+                "process_index": self.process_index,
+                "process_count": self.process_count,
+                "host_id": socket.gethostname(),
+            }
+            os.makedirs(
+                os.path.dirname(os.path.abspath(self.path)), exist_ok=True
+            )
             if not append:
-                open(path, "w").close()  # one file per fresh run
+                open(self.path, "w").close()  # one file per fresh run
 
     def log(self, record_type: str, **fields) -> None:
         if not self.path:
@@ -151,11 +184,12 @@ class JsonlLogger(Sink):
         import json
         import time as _time
 
-        import jax
-
-        if jax.process_index() != 0:
-            return
-        record = {"type": record_type, "ts": round(_time.time(), 3), **fields}
+        record = {
+            "type": record_type,
+            "ts": round(_time.time(), 3),
+            **self._meta,
+            **fields,
+        }
         with open(self.path, "a") as f:
             f.write(json.dumps(record) + "\n")
 

@@ -15,6 +15,11 @@ Rule catalog (see README "Static analysis"):
 * JL102 — branch-on-tracer: ``if``/``while`` on a traced parameter of a
   jitted function (``is None`` and ``isinstance`` checks are static and
   allowed; ``static_argnums`` positions are excluded).
+* JL103 — shape-polymorphic batch: a jitted callable invoked inside a
+  ``for``/``while`` loop with an argument sliced to a *non-constant* bound
+  (``x[:n]``, ``batch[i:j]``) — every distinct length is a new input shape,
+  so XLA silently recompiles per iteration (the classic ragged-final-batch
+  leak).  Constant bounds (``x[:64]``, ``x[:-1]``) are static and allowed.
 * JL201 — host sync in a device hot loop: ``.item()`` / ``float()`` /
   ``np.asarray`` / ``jax.device_get`` inside a ``for ... in <batches>`` loop.
 * JL301 — thread-shared state: a ``self.*`` attribute written by both the
@@ -49,6 +54,7 @@ RULES: Dict[str, str] = {
     "JL002": "restored host buffer flows into a donating program without jnp.copy",
     "JL101": "uncommitted Python scalar where replicated_scalar is required",
     "JL102": "branch on a traced value inside a jitted function",
+    "JL103": "non-constant slice fed to a jitted program inside a loop",
     "JL201": "host sync inside a device hot loop",
     "JL301": "attribute written by producer thread and consumer outside the lock",
     "JL302": "over-broad except handler silently swallows the error",
@@ -715,6 +721,125 @@ def _static_test(test: ast.expr) -> bool:
 
 
 # --------------------------------------------------------------------------- #
+# JL103: shape-polymorphic batches leaking into jitted programs
+# --------------------------------------------------------------------------- #
+
+
+def run_shape_poly(path: str, tree: ast.Module, index: ProjectIndex,
+                   out: List[Finding]) -> None:
+    jitted = _jitted_callable_names(tree, index)
+    attr_jitted = set(index.donating_attrs)  # matched on the attribute name
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for sub in _walk_no_defs(loop.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = _jitted_callee(sub, jitted, attr_jitted)
+            if callee is None:
+                continue
+            for arg in [*sub.args, *(kw.value for kw in sub.keywords)]:
+                bound = _dynamic_slice_bound(arg)
+                if bound is None:
+                    continue
+                out.append(Finding(
+                    path, arg.lineno, arg.col_offset, "JL103",
+                    f"`{ast.unparse(arg)}` slices to the non-constant bound "
+                    f"`{bound}` before entering jitted `{callee}` inside a "
+                    "loop: every distinct length is a new input shape and a "
+                    "silent recompile — pad to a fixed batch (or drop the "
+                    "ragged remainder) before the jit boundary",
+                ))
+
+
+def _jitted_callable_names(tree: ast.Module, index: ProjectIndex) -> Set[str]:
+    """Dotted names bound to jitted programs in this module: ``s = jax.jit(f)``
+    / ``self.step = pjit(f)``, ``@jax.jit`` (possibly via ``partial``)
+    decorated defs, and results of project-indexed builder calls."""
+    names: Set[str] = set()
+
+    def is_jit_call(val: ast.AST) -> bool:
+        if not isinstance(val, ast.Call):
+            return False
+        if dotted(val.func) in _JIT_NAMES:
+            return True
+        fname = dotted(val.func)
+        if fname and fname.split(".")[-1] in index.builders:
+            return True
+        # step = program.lower(...).compile()
+        return isinstance(val.func, ast.Attribute) and \
+            val.func.attr == "compile" and \
+            isinstance(val.func.value, ast.Call)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            tgts, val = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgts, val = [node.target], node.value
+        else:
+            tgts, val = [], None
+        if val is not None and is_jit_call(val):
+            for tgt in tgts:
+                name = dotted(tgt)
+                if name:
+                    names.add(name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):
+                    fname = dotted(dec.func) or ""
+                    if fname.split(".")[-1] == "partial" and dec.args:
+                        target = dec.args[0]  # @partial(jax.jit, ...)
+                    else:
+                        target = dec.func     # @jax.jit(donate_argnums=...)
+                if dotted(target) in _JIT_NAMES:
+                    names.add(node.name)
+    return names
+
+
+def _jitted_callee(call: ast.Call, jitted: Set[str],
+                   attr_jitted: Set[str]) -> Optional[str]:
+    # jax.jit(f)(x[:n]) — the program is built and invoked in place.
+    if isinstance(call.func, ast.Call) and dotted(call.func.func) in _JIT_NAMES:
+        return ast.unparse(call.func)
+    name = dotted(call.func)
+    if name is None:
+        # trainer._steps[ht](state, batch[:n]) — donating-dict container.
+        f = call.func
+        if isinstance(f, ast.Subscript) and isinstance(f.value, ast.Attribute) \
+                and f.value.attr in attr_jitted:
+            return f.value.attr
+        return None
+    if name in jitted:
+        return name
+    last = name.split(".")[-1]
+    if last in attr_jitted or last in jitted:
+        return last
+    return None
+
+
+def _dynamic_slice_bound(arg: ast.expr) -> Optional[str]:
+    """The first non-constant slice bound inside ``arg``, unparsed, or None."""
+    for sub in ast.walk(arg):
+        if not isinstance(sub, ast.Subscript):
+            continue
+        slices = [s for s in ast.walk(sub.slice) if isinstance(s, ast.Slice)]
+        for sl in slices:
+            for bound in (sl.lower, sl.upper):
+                if bound is not None and not _constant_bound(bound):
+                    return ast.unparse(bound)
+    return None
+
+
+def _constant_bound(b: ast.expr) -> bool:
+    if isinstance(b, ast.Constant):
+        return True
+    if isinstance(b, ast.UnaryOp) and isinstance(b.op, ast.USub):
+        return _constant_bound(b.operand)  # x[:-1] is a static shape
+    return False
+
+
+# --------------------------------------------------------------------------- #
 # JL201: host syncs inside device hot loops
 # --------------------------------------------------------------------------- #
 
@@ -930,6 +1055,7 @@ def run_rules(path: str, tree: ast.Module, index: ProjectIndex) -> List[Finding]
     DonationPass(path, tree, index, out).run()
     run_scalar_commit(path, tree, out)
     run_branch_on_tracer(path, tree, out)
+    run_shape_poly(path, tree, index, out)
     run_host_sync(path, tree, out)
     run_thread_shared(path, tree, out)
     run_swallowed_errors(path, tree, out)

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -417,6 +418,10 @@ def measure(batch_size: int, iters: int, compute_dtype: str, fused_n: int,
         "fused_epoch_ms": round(epoch_dt * 1e3, 2),
         "backend": backend,
         "devices": jax.device_count(),
+        # Which host/process measured: a fleet's bench lines must be
+        # attributable the same way its telemetry records are.
+        "host_id": socket.gethostname(),
+        "process_index": jax.process_index(),
         "compute_dtype": compute_dtype,
         "loss_finite": bool(np.isfinite(float(m["loss"]))),
         # Fixed per-fetch RPC cost removed by the slope timing (transparency).

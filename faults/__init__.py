@@ -12,4 +12,5 @@ from .injector import (  # noqa: F401
     FaultInjector,
     injector_from,
     parse_fault_spec,
+    rotate_ledger,
 )

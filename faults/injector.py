@@ -171,10 +171,16 @@ class FaultInjector:
         ledger_path: Optional[str] = None,
         sink=None,
         slow_s: float = 0.25,
+        on_fatal=None,
     ):
         self.ledger_path = ledger_path
         self.sink = sink
         self.slow_s = slow_s
+        # Called (no args) after the ledger write but before an uncatchable
+        # ``kill`` executes — the engine points this at the flight recorder's
+        # fatal dump so the crash tail survives the SIGKILL.  A callback (not
+        # an import) because faults/ is stdlib-only by contract.
+        self.on_fatal = on_fatal
         spent = self._load_ledger()
         self._armed: List[FaultClause] = []
         for c in clauses:
@@ -206,6 +212,11 @@ class FaultInjector:
             self._armed.remove(clause)
             self._record(clause, site, coords)
             if clause.action == "kill":
+                if self.on_fatal is not None:
+                    try:
+                        self.on_fatal()
+                    except Exception:  # jaxlint: disable=JL302
+                        pass  # forensics must never block the injected death
                 os.kill(os.getpid(), signal.SIGKILL)
             elif clause.action in ("raise", "producer_die"):
                 raise FaultInjected(clause, site, coords)
@@ -258,13 +269,38 @@ class FaultInjector:
         return spent
 
 
+def rotate_ledger(path: Optional[str]) -> Optional[str]:
+    """Archive a spent fire-ledger to ``<path>.<n>`` (lowest free n).
+
+    A *fresh* (non-``--resume``) run with a ``--fault_spec`` wants its
+    clauses armed — but a leftover ledger from the previous soak iteration
+    would mark them spent, and deleting it by hand defeats repeatable chaos
+    soaks.  Rotation keeps the history (every archived ledger is forensic
+    evidence) while re-arming the spec.  Resumed runs must NOT rotate: the
+    spent ledger is exactly what keeps a relaunch out of a crash loop.
+
+    Returns the archive path, or None when there was nothing to rotate.
+    """
+    if not path or not os.path.exists(path):
+        return None
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    os.replace(path, f"{path}.{n}")
+    return f"{path}.{n}"
+
+
 def injector_from(
     spec: Optional[str],
     ledger_path: Optional[str] = None,
     sink=None,
+    on_fatal=None,
 ) -> Optional[FaultInjector]:
     """The trainer's entry point: ``None`` when no spec is configured, so the
     hot paths pay exactly one ``is not None`` check."""
     if not spec:
         return None
-    return FaultInjector(parse_fault_spec(spec), ledger_path=ledger_path, sink=sink)
+    return FaultInjector(
+        parse_fault_spec(spec), ledger_path=ledger_path, sink=sink,
+        on_fatal=on_fatal,
+    )

@@ -17,6 +17,11 @@ exception a test harness can intercept):
    (``resume.kind == "epoch"`` at task 1, epoch 2 — not a task-boundary
    restart), and that the final accuracy matrix, acc1 trajectory and
    alignment γ are **bit-identical** to the twin's.
+4. Assert the crash left a forensic trail: the supervisor harvested a
+   ``crash_report.json`` whose flight-recorder tail contains the killed
+   process's ``fault_injected`` event with the ``task`` span still open
+   (the kill fires at the engine.epoch site, after the epoch span closed),
+   and ``report_run.py`` renders a crash timeline naming that span.
 
 Exit 0 on exact match, 1 otherwise, one JSON line either way.
 Used by ``scripts/ci.sh``; runnable standalone from anywhere.
@@ -80,8 +85,10 @@ def _task_gammas(records):
 def main() -> int:
     with tempfile.TemporaryDirectory(prefix="chaos_smoke_") as tmp:
         twin_log = os.path.join(tmp, "twin.jsonl")
-        chaos_log = os.path.join(tmp, "chaos.jsonl")
+        tdir = os.path.join(tmp, "chaos_tel")
+        chaos_log = os.path.join(tdir, "run.jsonl")  # --telemetry_dir default
         ckpt_dir = os.path.join(tmp, "ckpt")
+        ledger = os.path.join(ckpt_dir, "fault_ledger.jsonl")
 
         twin_cmd = [sys.executable, os.path.join(_REPO, "train.py"),
                     *_PROTO, "--log_file", twin_log]
@@ -95,9 +102,11 @@ def main() -> int:
             sys.executable, os.path.join(_REPO, "scripts", "supervise.py"),
             "--backoff_base", "0.1", "--backoff_max", "1",
             "--max_failures", "3", "--failure_window", "120",
+            "--telemetry_dir", tdir,
+            "--fault_ledger", ledger,
             "--",
             sys.executable, os.path.join(_REPO, "train.py"), *_PROTO,
-            "--log_file", chaos_log,
+            "--telemetry_dir", tdir,
             "--ckpt_dir", ckpt_dir,
             "--epoch_ckpt_every", "1",
             "--fault_spec", "kill@task1.epoch2",
@@ -142,6 +151,44 @@ def main() -> int:
             failures.append(
                 f"final matrix row differs: twin={twin_task.get('acc_per_task')} "
                 f"chaos={chaos_task.get('acc_per_task')}")
+
+        # Crash forensics: the supervisor must have harvested the killed
+        # process's flight-recorder tail into crash_report.json ...
+        crash_path = os.path.join(tdir, "crash_report.json")
+        last_open = None
+        if not os.path.exists(crash_path):
+            failures.append("supervisor harvested no crash_report.json")
+        else:
+            with open(crash_path) as f:
+                crash = json.load(f)
+            dumps = crash.get("flight_dumps", [])
+            fatal = [d for d in dumps
+                     if any(e.get("type") == "fault_injected"
+                            for e in d.get("events", []))]
+            if not fatal:
+                failures.append(
+                    "crash_report flight dumps lack the fault_injected "
+                    f"event (reasons={[d.get('reason') for d in dumps]})")
+            else:
+                last_open = fatal[-1].get("last_open_span")
+                # The kill fires at the engine.epoch site, after the epoch
+                # span closed: the task span is what death interrupted.
+                if last_open != "task":
+                    failures.append(
+                        f"flight dump last_open_span={last_open!r}, "
+                        "want 'task'")
+            if not crash.get("fault_ledger"):
+                failures.append("crash_report carries no fault-ledger entries")
+        # ... and report_run.py must render it as a crash timeline naming
+        # the span that was open at death.
+        report = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", "report_run.py"),
+             chaos_log],
+            cwd=_REPO, timeout=120, capture_output=True, text=True)
+        if "last open span at death: task" not in report.stdout:
+            failures.append(
+                "report_run.py crash timeline does not name the open span "
+                f"(rc={report.returncode})")
 
         print(json.dumps({
             "metric": "chaos_smoke",

@@ -133,6 +133,9 @@ SCHEMA = {
         {"group": str, "task_id": NUM},
         None,
     ),
+    # A fresh (non-resume) run archived the previous soak's spent fire
+    # ledger so the --fault_spec re-armed (faults.rotate_ledger).
+    "fault_ledger_rotated": ({"path": str, "archived": str}, {}, None),
     "span": (
         {"name": str, "span_id": NUM, "depth": NUM, "ts": NUM, "dur_s": NUM},
         {"parent": (int, float, type(None))},
@@ -141,6 +144,7 @@ SCHEMA = {
     "heartbeat": (
         {"ts": NUM, "seq": NUM, "pid": NUM},
         {
+            "mono": NUM,  # monotonic stamp for cross-process clock alignment
             "step": NUM,
             "task": NUM,
             "epoch": NUM,
@@ -151,11 +155,47 @@ SCHEMA = {
         },
         None,
     ),
+    # Flight recorder (telemetry/flight.py): the ring-buffer tail dumped on
+    # every death path (and each heartbeat).  `events` holds raw sink/span/
+    # heartbeat records — they are forensic payload, not re-validated here
+    # (a crash tail legitimately contains torn or partial records).
+    "flight_dump": (
+        {"reason": str, "pid": NUM, "events": list},
+        {
+            "capacity": NUM,
+            "dropped": NUM,
+            "open_spans": list,
+            "last_open_span": (str, type(None)),
+        },
+        None,
+    ),
+    # Supervisor harvest (scripts/supervise.py): flight dumps + heartbeats +
+    # fault ledger gathered into one artifact before each relaunch.
+    "crash_report": (
+        {"returncode": NUM, "hung": bool, "attempt": NUM},
+        {
+            "uptime_s": NUM,
+            "telemetry_dir": str,
+            "flight_dumps": list,
+            "heartbeats": list,
+            "fault_ledger": list,
+        },
+        None,
+    ),
 }
 
 # Every JsonlLogger record carries a writer timestamp; spans/heartbeats
 # stamp their own.  "ts" is therefore universally required.
 ALWAYS_REQUIRED = {"ts": NUM}
+
+# Process-identity tags every record may carry since PR 6 (JsonlLogger
+# stamps all three; spans/heartbeats stamp process_index): optional so the
+# committed pre-fleet evidence logs stay valid.
+ALWAYS_OPTIONAL = {
+    "process_index": NUM,
+    "process_count": NUM,
+    "host_id": str,
+}
 
 
 def check_record(rec: dict, where: str) -> list:
@@ -165,6 +205,7 @@ def check_record(rec: dict, where: str) -> list:
         return [f"{where}: unknown record type {rtype!r}"]
     required, optional, extras = SCHEMA[rtype]
     required = {**ALWAYS_REQUIRED, **required}
+    optional = {**ALWAYS_OPTIONAL, **optional}
     for field, types in required.items():
         if field not in rec:
             errs.append(f"{where}: {rtype} record missing required {field!r}")

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""CI perf regression gate: bench.py vs the committed BASELINE.json entry.
+
+Usage: python scripts/perf_gate.py                  # gate (ci.sh stage)
+       python scripts/perf_gate.py --update-baseline  # (re)record the entry
+       python scripts/perf_gate.py --result '<json>'  # gate a canned result
+
+Runs ``bench.py`` (the CPU reduced fallback under ``JAX_PLATFORMS=cpu``:
+batch 64, 5 iters — ~30 s with a warm compile cache), parses its single JSON
+line, and compares against the ``bench_gate`` entry in ``BASELINE.json``:
+
+* ``step_ms`` is the hard gate: measured > baseline × (1 + tolerance)
+  (default 15%) fails the stage — a perf regression is a CI failure, not a
+  footnote in a round log.
+* ``fetch_overhead_ms`` is gated loosely (3× + 5 ms), and only when the
+  baseline recorded a meaningful (≥ 1 ms) overhead: the slope-intercept
+  estimate is scheduler noise at smaller magnitudes, but an input pipeline
+  that *collapsed* (prefetch disabled, decode gone synchronous) still trips.
+* A baseline recorded on a different backend or global batch is
+  incomparable: the gate SKIPs (exit 0) with a warning instead of judging
+  TPU numbers against a CPU baseline.
+* A bench error / zero value always fails — a broken bench must not read as
+  "no regression".
+
+Exit 0 on pass/skip, 1 on fail, one JSON verdict line either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join(_REPO, "BASELINE.json")
+
+DEFAULT_TOLERANCE = 0.15
+FETCH_FACTOR = 3.0   # loose multiplicative gate for fetch_overhead_ms
+FETCH_SLACK_MS = 5.0  # absolute slack on top of the factor
+FETCH_ARM_MS = 1.0   # the fetch gate arms only at a meaningful baseline
+
+
+def run_bench(timeout_s: float = 600.0) -> dict:
+    """Run bench.py on CPU and parse the last JSON line of its stdout."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        cwd=_REPO, env=env, capture_output=True, text=True,
+        timeout=timeout_s,
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {"error": f"bench.py produced no JSON (rc={proc.returncode})"}
+
+
+def gate(result: dict, baseline: dict) -> dict:
+    """Pure comparison: {'status': 'pass'|'fail'|'skip', 'reasons': [...]}.
+
+    Separated from the subprocess plumbing so tests can gate canned results.
+    """
+    reasons = []
+    if result.get("error") or not result.get("value"):
+        return {"status": "fail",
+                "reasons": [f"bench did not produce a valid measurement: "
+                            f"{result.get('error', 'value=0')}"]}
+    for key in ("backend", "global_batch"):
+        if baseline.get(key) is not None and result.get(key) != baseline[key]:
+            return {"status": "skip",
+                    "reasons": [f"incomparable {key}: baseline "
+                                f"{baseline[key]!r} vs measured "
+                                f"{result.get(key)!r} — refresh the baseline "
+                                "on this machine (--update-baseline)"]}
+    tol = baseline.get("tolerance", DEFAULT_TOLERANCE)
+    base_step = baseline.get("step_ms")
+    step = result.get("step_ms")
+    if base_step is None or step is None:
+        return {"status": "skip",
+                "reasons": ["no step_ms to compare (baseline entry missing "
+                            "— record one with --update-baseline)"]}
+    limit = base_step * (1.0 + tol)
+    if step > limit:
+        reasons.append(
+            f"step_ms regressed: {step:.1f} > {limit:.1f} "
+            f"(baseline {base_step:.1f} + {tol:.0%})")
+    base_fetch = baseline.get("fetch_overhead_ms")
+    fetch = result.get("fetch_overhead_ms")
+    if (base_fetch is not None and fetch is not None
+            and base_fetch >= FETCH_ARM_MS):
+        # Below FETCH_ARM_MS the slope-intercept overhead estimate is pure
+        # scheduler noise (observed 0 <-> 250 ms run to run on CPU); the
+        # gate arms only when the baseline recorded a real overhead.
+        fetch_limit = base_fetch * FETCH_FACTOR + FETCH_SLACK_MS
+        if fetch > fetch_limit:
+            reasons.append(
+                f"fetch_overhead_ms collapsed: {fetch:.1f} > "
+                f"{fetch_limit:.1f} (baseline {base_fetch:.1f})")
+    if not reasons and step < base_step * (1.0 - tol):
+        # Not a failure — but a silently stale baseline hides the *next*
+        # regression inside the improvement's slack.
+        reasons.append(
+            f"note: step_ms improved {base_step:.1f} -> {step:.1f}; "
+            "refresh the baseline to tighten the gate")
+        return {"status": "pass", "reasons": reasons}
+    return {"status": "fail" if reasons else "pass", "reasons": reasons}
+
+
+def load_baseline(path: str = _BASELINE) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def update_baseline(result: dict, path: str = _BASELINE) -> dict:
+    doc = load_baseline(path)
+    entry = {
+        "step_ms": result.get("step_ms"),
+        "fetch_overhead_ms": result.get("fetch_overhead_ms"),
+        "backend": result.get("backend"),
+        "global_batch": result.get("global_batch"),
+        "img_s": result.get("value"),
+        "tolerance": DEFAULT_TOLERANCE,
+        "recorded_ts": round(time.time(), 3),
+    }
+    doc["bench_gate"] = entry
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return entry
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--update-baseline", action="store_true",
+                   help="run bench.py and write its numbers as the new "
+                   "bench_gate entry instead of gating")
+    p.add_argument("--result", default=None,
+                   help="gate this JSON result instead of running bench.py "
+                   "(tests / canned measurements)")
+    p.add_argument("--baseline", default=_BASELINE,
+                   help="path to BASELINE.json")
+    args = p.parse_args(argv)
+
+    result = (json.loads(args.result) if args.result else run_bench())
+    if args.update_baseline:
+        entry = update_baseline(result, args.baseline)
+        print(json.dumps({"metric": "perf_gate", "status": "updated",
+                          "bench_gate": entry}))
+        return 0 if not result.get("error") else 1
+    baseline = load_baseline(args.baseline).get("bench_gate", {})
+    verdict = gate(result, baseline)
+    print(json.dumps({
+        "metric": "perf_gate",
+        "status": verdict["status"],
+        "reasons": verdict["reasons"],
+        "measured": {k: result.get(k) for k in
+                     ("step_ms", "fetch_overhead_ms", "value", "backend",
+                      "global_batch")},
+        "baseline": baseline or None,
+    }))
+    return 1 if verdict["status"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,13 +22,21 @@ span file next to it, auto-discovered when not given):
 * per-device HBM samples when the backend reports them,
 * span phase coverage: how much of the ``fit`` wall time the depth-1 task
   spans account for (the acceptance gate is >= 95%), and the phase-level
-  time breakdown under them.
+  time breakdown under them,
+* fleet telemetry: per-process sibling streams (``run_p<i>.jsonl``) merged
+  into one report, wall clocks aligned via the heartbeat ``ts``/``mono``
+  anchors,
+* crash timeline: the supervisor's ``crash_report.json`` (or raw
+  ``flight_*.json`` dumps) rendered as each process's last-events tail,
+  ending with the span that was still open when it died.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 
@@ -193,6 +201,170 @@ def render_spans(spans_path: str):
         print()
 
 
+# --------------------------------------------------------------------------- #
+# Fleet telemetry: multi-process stream merge + crash forensics
+# --------------------------------------------------------------------------- #
+
+
+def discover_process_streams(run_path: str) -> dict:
+    """``{process_index: path}`` for a run log and its per-process siblings.
+
+    Process 0 writes the legacy name (``run.jsonl``), process *i* writes
+    ``run_p{i}.jsonl`` (``utils.logging.process_suffixed``) — the single-
+    process case degrades to ``{0: run_path}`` with no sibling scan hits.
+    """
+    stem, ext = os.path.splitext(run_path)
+    out = {0: run_path}
+    for p in sorted(glob.glob(f"{glob.escape(stem)}_p[0-9]*{ext}")):
+        m = re.search(r"_p(\d+)" + re.escape(ext) + r"$", p)
+        if m:
+            out[int(m.group(1))] = p
+    return out
+
+
+def read_fleet_heartbeats(run_dir: str) -> dict:
+    """``{process_index: beat}`` from ``heartbeat.json`` + per-process
+    siblings next to the run log (unreadable files are skipped)."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(glob.escape(run_dir) or ".",
+                                           "heartbeat*.json"))):
+        try:
+            with open(p) as f:
+                beat = json.load(f)
+        except (OSError, ValueError):
+            continue
+        m = re.search(r"heartbeat_p(\d+)\.json$", p)
+        out[int(m.group(1)) if m else beat.get("process_index", 0)] = beat
+    return out
+
+
+def clock_offsets(heartbeats: dict) -> dict:
+    """Per-process wall-clock offset (seconds) relative to process 0.
+
+    Each beat stamps the wall clock (``ts``) and the monotonic clock
+    (``mono``) at the same instant, so ``ts - mono`` is a per-process clock
+    anchor and the difference of anchors is the skew:
+    ``aligned_ts = ts - offset[p]`` puts every stream on process 0's clock.
+    Processes without a usable anchor (old logs, missing beats) get 0.0 —
+    unaligned beats worse than dropped.  Note this trusts the monotonic
+    clocks to tick at the same rate (same boot for a simulated fleet; NTP-
+    disciplined hosts in a real pod), which is exactly the skew class
+    heartbeats exhibit in practice.
+    """
+    base = None
+    b0 = heartbeats.get(0)
+    if b0 and "ts" in b0 and "mono" in b0:
+        base = b0["ts"] - b0["mono"]
+    out = {}
+    for pi, beat in heartbeats.items():
+        if base is not None and beat and "ts" in beat and "mono" in beat:
+            out[pi] = round((beat["ts"] - beat["mono"]) - base, 3)
+        else:
+            out[pi] = 0.0
+    return out
+
+
+def render_fleet(run_path: str) -> dict:
+    """Merge per-process streams into one fleet section; returns
+    ``{process_index: by_type}`` so the caller can reuse the merged load.
+    Prints nothing in the single-process case (legacy reports unchanged)."""
+    streams = discover_process_streams(run_path)
+    merged = {pi: load_records(p) for pi, p in streams.items()}
+    if len(streams) <= 1:
+        return merged
+    heartbeats = read_fleet_heartbeats(os.path.dirname(run_path))
+    offsets = clock_offsets(heartbeats)
+    print(f"fleet telemetry: {len(streams)} process stream(s) merged "
+          "(timestamps aligned to process 0's clock via heartbeat "
+          "ts/mono anchors):\n")
+    print("| proc | host | records | faults | last record | "
+          "last ts (aligned) | clock skew s |")
+    print("|---|---|---|---|---|---|---|")
+    for pi in sorted(merged):
+        recs = [r for rs in merged[pi].values() for r in rs]
+        recs.sort(key=lambda r: r.get("ts", 0))
+        last = recs[-1] if recs else None
+        host = next((r["host_id"] for r in recs if "host_id" in r), "?")
+        off = offsets.get(pi, 0.0)
+        aligned = f"{last['ts'] - off:.3f}" if last else "—"
+        print(f"| {pi} | {host} | {len(recs)} | "
+              f"{len(merged[pi]['fault_injected'])} | "
+              f"{last['type'] if last else '—'} | {aligned} | {off:+.3f} |")
+    print()
+    return merged
+
+
+def _event_label(e: dict) -> str:
+    """One-line description of a flight event for the crash timeline."""
+    keys = ("name", "task", "task_id", "epoch", "step", "phase", "spec",
+            "site", "where")
+    detail = " ".join(f"{k}={e[k]}" for k in keys if e.get(k) is not None)
+    return f"{e.get('type', '?')}" + (f" [{detail}]" if detail else "")
+
+
+def render_crash_timeline(run_path: str) -> None:
+    """Per-process crash timeline from the supervisor's ``crash_report.json``
+    (or, lacking one, the raw ``flight_*.json`` dumps) next to the run log:
+    the flight-recorder tail of each process and the span that was still
+    open when it died."""
+    run_dir = os.path.dirname(run_path)
+    report = None
+    crash_path = os.path.join(run_dir, "crash_report.json")
+    if os.path.exists(crash_path):
+        try:
+            with open(crash_path) as f:
+                report = json.load(f)
+        except (OSError, ValueError):
+            report = None
+    if report is not None:
+        dumps = report.get("flight_dumps", [])
+        src = crash_path
+    else:
+        dumps = []
+        for p in sorted(glob.glob(os.path.join(glob.escape(run_dir) or ".",
+                                               "flight_*.json"))):
+            try:
+                with open(p) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                continue
+            # Clean-exit dumps are steady-state artifacts, not crashes.
+            if d.get("reason") not in ("close", "atexit"):
+                dumps.append(d)
+        src = run_dir
+    if not dumps:
+        return
+    print(f"crash timeline (from {src}):\n")
+    if report is not None:
+        print(f"child exit: returncode={report.get('returncode')} "
+              f"hung={report.get('hung')} "
+              f"uptime={report.get('uptime_s', '?')}s "
+              f"attempt={report.get('attempt', '?')}")
+        if report.get("fault_ledger"):
+            specs = [rec.get("spec") for rec in report["fault_ledger"]]
+            print(f"fault ledger: {specs}")
+        print()
+    for dump in dumps:
+        pi = dump.get("process_index", 0)
+        t_dump = dump.get("ts", 0)
+        events = dump.get("events", [])
+        print(f"process {pi} (host {dump.get('host_id', '?')}, "
+              f"pid {dump.get('pid', '?')}): dump reason "
+              f"{dump.get('reason', '?')!r}, {len(events)} event(s) "
+              f"buffered, {dump.get('dropped', 0)} older dropped")
+        for e in events[-12:]:
+            rel = e.get("ts", t_dump) - t_dump
+            print(f"  {rel:+9.3f}s  {_event_label(e)}")
+        open_spans = dump.get("open_spans") or []
+        if open_spans:
+            chain = " > ".join(s.get("name", "?") for s in open_spans)
+            print(f"  open spans at death: {chain}")
+            print(f"  last open span at death: {dump.get('last_open_span')}")
+        else:
+            print("  open spans at death: none")
+        print()
+
+
 def _is_run_log(by_type) -> bool:
     return bool(by_type["task"] or by_type["epoch"] or by_type["run"]
                 or by_type["final"])
@@ -345,11 +517,13 @@ def main(run_path: str, second_path: str | None = None):
     render_stalls(by_type["epoch"])
     render_recompiles(by_type["recompile"], by_type["recompile_warning"])
     render_hbm(by_type["hbm"])
+    render_fleet(run_path)
     if spans_path is None:
         candidate = os.path.join(os.path.dirname(run_path), "spans.jsonl")
         spans_path = candidate if os.path.exists(candidate) else None
     if spans_path:
         render_spans(spans_path)
+    render_crash_timeline(run_path)
 
 
 if __name__ == "__main__":

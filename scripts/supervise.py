@@ -15,12 +15,19 @@ file this supervisor also watches):
   injected ``kill@...`` fault delivers) ⇒ relaunch under exponential backoff,
   appending ``--resume`` (once) so the child continues from its newest valid
   checkpoint.
-* Hang (heartbeat file stale beyond ``--max_age`` while the child still
-  lives) ⇒ kill the whole process group, then treat it as a crash.
+* Hang (any per-process heartbeat file stale beyond ``--max_age`` while the
+  child still lives) ⇒ kill the whole process group, then treat it as a
+  crash.  ``--heartbeat`` names process 0's file; per-process siblings
+  (``heartbeat_p<i>.json``) are probed automatically.
 * Crash-loop breaker: more than ``--max_failures`` failures inside a sliding
   ``--failure_window`` ⇒ stop relaunching, report, exit 2.  An uptime longer
   than the window resets the count — a run that trains for an hour between
   two unrelated preemptions is not a crash loop.
+* Crash forensics: on every failure, before relaunching, the supervisor
+  harvests the flight-recorder dumps (``flight_*.json``), the last
+  per-process heartbeats, and the fault ledger into one atomic
+  ``<telemetry_dir>/crash_report.json`` — a self-contained artifact that
+  survives the relaunch overwriting the live telemetry files.
 
 Stdlib-only (like ``analysis/`` and ``faults/``): the supervisor must never
 import jax — it outlives trainer processes whose jax runtime is wedged.
@@ -39,6 +46,7 @@ Every supervisor decision is emitted as a JSON line on stdout (and to
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import signal
@@ -77,6 +85,15 @@ def _parse_args(argv: List[str]):
                    help="flag appended (once) to the command after the "
                    "first crash so relaunches continue from the newest "
                    "checkpoint; '' disables")
+    p.add_argument("--telemetry_dir", default=None,
+                   help="the child's --telemetry_dir; flight dumps and "
+                   "heartbeats are harvested from here into "
+                   "crash_report.json on every failure (defaults to the "
+                   "--heartbeat file's directory)")
+    p.add_argument("--fault_ledger", default=None,
+                   help="the child's fault fire-ledger "
+                   "(<ckpt_dir>/fault_ledger.jsonl); included in "
+                   "crash_report.json when given")
     p.add_argument("--log", default=None,
                    help="also append the JSON event lines here")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -105,16 +122,32 @@ class Supervisor:
             with open(self.args.log, "a") as f:
                 f.write(line + "\n")
 
+    def _heartbeat_paths(self) -> List[str]:
+        """The configured heartbeat plus its per-process siblings
+        (``heartbeat_p<i>.json``) — in a multi-process run every process
+        beats into its own file, and any one going stale is a hang."""
+        hb = self.args.heartbeat
+        if not hb:
+            return []
+        stem, ext = os.path.splitext(hb)
+        return [hb] + sorted(glob.glob(f"{stem}_p[0-9]*{ext}"))
+
     def _heartbeat_stale(self) -> Optional[float]:
-        """Age in seconds when the heartbeat is stale, else None."""
-        hb, max_age = self.args.heartbeat, self.args.max_age
-        if not hb or max_age <= 0:
+        """Worst stale age in seconds across per-process heartbeats, else
+        None.  A file not written yet is not stale (grace covers startup),
+        but one process's dead heartbeat hangs the fleet."""
+        max_age = self.args.max_age
+        if max_age <= 0:
             return None
-        try:
-            age = time.time() - os.stat(hb).st_mtime
-        except OSError:
-            return None  # not written yet; the grace period covers startup
-        return age if age > max_age else None
+        worst = None
+        for path in self._heartbeat_paths():
+            try:
+                age = time.time() - os.stat(path).st_mtime
+            except OSError:
+                continue  # not written yet; the grace period covers startup
+            if age > max_age and (worst is None or age > worst):
+                worst = age
+        return worst
 
     def _kill_group(self, proc: subprocess.Popen) -> None:
         """SIGTERM then SIGKILL the child's whole process group (the trainer
@@ -131,7 +164,8 @@ class Supervisor:
                 continue
 
     def _run_once(self, cmd: List[str]):
-        """Launch and babysit one child; returns (returncode, uptime_s)."""
+        """Launch and babysit one child; returns (returncode, uptime_s,
+        hung)."""
         start = time.monotonic()
         proc = subprocess.Popen(cmd, start_new_session=True)
         self._event("launch", pid=proc.pid, cmd=cmd)
@@ -155,7 +189,88 @@ class Supervisor:
         uptime = time.monotonic() - start
         self._event("exit", pid=proc.pid, returncode=rc, hung=hung,
                     uptime_s=round(uptime, 1))
-        return rc, uptime
+        return rc, uptime, hung
+
+    # ------------------------------------------------------------------ #
+    # Crash forensics
+    # ------------------------------------------------------------------ #
+
+    def _telemetry_dir(self) -> Optional[str]:
+        if self.args.telemetry_dir:
+            return self.args.telemetry_dir
+        if self.args.heartbeat:
+            return os.path.dirname(os.path.abspath(self.args.heartbeat))
+        return None
+
+    @staticmethod
+    def _read_json(path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # missing / torn file: absence is its own evidence
+
+    @staticmethod
+    def _read_jsonl(path: str) -> List[dict]:
+        out: List[dict] = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn trailing line of a killed process
+        except OSError:
+            return out
+        return out
+
+    def _write_crash_report(self, rc: int, hung: bool, uptime: float,
+                            attempt: int) -> None:
+        """Harvest flight dumps + heartbeats + fault ledger into one atomic
+        ``crash_report.json`` before the relaunch overwrites the live files.
+        Best-effort by design: forensics must never block recovery."""
+        tdir = self._telemetry_dir()
+        if not tdir or not os.path.isdir(tdir):
+            return
+        flight_dumps = [
+            d for d in (
+                self._read_json(p)
+                for p in sorted(glob.glob(os.path.join(tdir, "flight_*.json")))
+            ) if d is not None
+        ]
+        heartbeats = [
+            b for b in (self._read_json(p) for p in self._heartbeat_paths()
+                        or sorted(glob.glob(os.path.join(
+                            tdir, "heartbeat*.json"))))
+            if b is not None
+        ]
+        report = {
+            "type": "crash_report",
+            "ts": round(time.time(), 3),
+            "returncode": rc,
+            "hung": hung,
+            "uptime_s": round(uptime, 1),
+            "attempt": attempt,
+            "telemetry_dir": tdir,
+            "flight_dumps": flight_dumps,
+            "heartbeats": heartbeats,
+            "fault_ledger": (self._read_jsonl(self.args.fault_ledger)
+                             if self.args.fault_ledger else []),
+        }
+        path = os.path.join(tdir, "crash_report.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(report, f)
+            os.replace(tmp, path)
+        except OSError:
+            return  # a full disk must not stop the relaunch loop
+        self._event("crash_report", path=path,
+                    flight_dumps=len(flight_dumps),
+                    heartbeats=len(heartbeats))
 
     # ------------------------------------------------------------------ #
 
@@ -166,10 +281,11 @@ class Supervisor:
         consecutive = 0
         while True:
             attempt += 1
-            rc, uptime = self._run_once(cmd)
+            rc, uptime, hung = self._run_once(cmd)
             if rc == 0:
                 self._event("done", attempts=attempt)
                 return 0
+            self._write_crash_report(rc, hung, uptime, attempt)
             now = time.monotonic()
             if uptime > args.failure_window:
                 # A long-lived child that eventually died is a fresh

@@ -60,19 +60,27 @@ HEARTBEAT=${HEARTBEAT:-experiments/heartbeat.json}
 HB_MAX_AGE=${HB_MAX_AGE:-120}
 
 heartbeat_fresh() {  # prints the beat summary and returns 0 when fresh
+  # Probes $HEARTBEAT plus every per-process sibling (heartbeat_p<i>.json —
+  # each JAX process beats into its own file); a fleet is fresh only when
+  # every process that has ever beaten is fresh.
   python - "$HEARTBEAT" "$HB_MAX_AGE" <<'PY'
-import sys
+import glob, os, sys
 sys.path.insert(0, ".")
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
     read_heartbeat,
 )
 
-beat = read_heartbeat(sys.argv[1], float(sys.argv[2]))
-if beat.get("fresh"):
+primary, max_age = sys.argv[1], float(sys.argv[2])
+stem, ext = os.path.splitext(primary)
+paths = [primary] + sorted(glob.glob(f"{glob.escape(stem)}_p[0-9]*{ext}"))
+beats = {p: read_heartbeat(p, max_age) for p in paths if os.path.exists(p)}
+if beats and all(b.get("fresh") for b in beats.values()):
+    beat = beats[primary] if primary in beats else next(iter(beats.values()))
+    worst = max(b["age_s"] for b in beats.values())
     print(
-        f"age={beat['age_s']}s pid={beat.get('pid')} step={beat.get('step')} "
-        f"task={beat.get('task')} epoch={beat.get('epoch')} "
-        f"phase={beat.get('phase')}"
+        f"procs={len(beats)} worst_age={worst}s pid={beat.get('pid')} "
+        f"step={beat.get('step')} task={beat.get('task')} "
+        f"epoch={beat.get('epoch')} phase={beat.get('phase')}"
     )
     sys.exit(0)
 sys.exit(1)

@@ -517,3 +517,61 @@ def test_chaos_smoke_end_to_end():
     bit-identical final matrix (also run as the CI chaos stage)."""
     chaos = _load_script("chaos_smoke")
     assert chaos.main() == 0
+
+
+# --------------------------------------------------------------------------- #
+# on_fatal forensics hook + ledger rotation
+# --------------------------------------------------------------------------- #
+
+
+def test_on_fatal_runs_before_the_kill(monkeypatch):
+    import signal as _signal
+
+    order = []
+    monkeypatch.setattr(
+        os, "kill", lambda pid, sig: order.append(("kill", sig)))
+    inj = injector_from("kill@task1.epoch2",
+                        on_fatal=lambda: order.append(("dump", None)))
+    inj.fire("engine.epoch", task=1, epoch=2)
+    # The flight dump lands before SIGKILL: dump order is the whole point.
+    assert order == [("dump", None), ("kill", _signal.SIGKILL)]
+
+
+def test_on_fatal_failure_never_blocks_the_kill(monkeypatch):
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append(sig))
+
+    def broken_dump():
+        raise RuntimeError("disk full")
+
+    inj = injector_from("kill@task0", on_fatal=broken_dump)
+    inj.fire("engine.epoch", task=0, epoch=1)
+    assert len(kills) == 1  # the injected death still happens
+
+
+def test_on_fatal_not_called_for_nonfatal_actions(monkeypatch):
+    calls = []
+    inj = injector_from("raise@task0", on_fatal=lambda: calls.append(1))
+    with pytest.raises(FaultInjected):
+        inj.fire("engine.epoch", task=0, epoch=1)
+    assert calls == []  # a raise is catchable: normal death paths handle it
+
+
+def test_rotate_ledger_archives_and_numbers(tmp_path):
+    from faults import rotate_ledger
+
+    path = str(tmp_path / "fault_ledger.jsonl")
+    # Nothing to rotate: both missing-path and None are no-ops.
+    assert rotate_ledger(path) is None
+    assert rotate_ledger(None) is None
+    with open(path, "w") as f:
+        f.write(json.dumps({"spec": "kill@task1", "action": "kill"}) + "\n")
+    first = rotate_ledger(path)
+    assert first == path + ".1"
+    assert not os.path.exists(path)  # the live ledger starts fresh
+    assert json.loads(open(first).read())["spec"] == "kill@task1"
+    # A second chaos soak rotates to the next free slot, keeping .1 intact.
+    with open(path, "w") as f:
+        f.write(json.dumps({"spec": "raise@task0", "action": "raise"}) + "\n")
+    assert rotate_ledger(path) == path + ".2"
+    assert os.path.exists(first)

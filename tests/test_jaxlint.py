@@ -217,6 +217,89 @@ def test_jl102_is_none_test_allowed(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# JL103 — shape-polymorphic batch into a jitted program inside a loop
+# --------------------------------------------------------------------------- #
+
+
+def test_jl103_dynamic_slice_in_loop(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda b: b)
+
+        def run(batches, n):
+            for b in batches:
+                step(b[:n])  # ragged final batch: recompile per length
+        """)
+    assert rules_of(findings) == ["JL103"]
+    (f,) = findings
+    assert "`n`" in f.message and "recompile" in f.message
+
+
+def test_jl103_decorated_jit_in_while_loop(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(x):
+            return x
+
+        def run(bs, n):
+            i = 0
+            while i < 10:
+                step(bs[i:n])
+                i += 1
+        """)
+    assert rules_of(findings) == ["JL103"]
+
+
+def test_jl103_constant_bounds_are_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda b: b)
+
+        def run(batches):
+            for b in batches:
+                step(b[:64])   # fixed shape
+                step(b[:-1])   # constant negative bound: still one shape
+                step(b[1:8])
+        """)
+    assert findings == []
+
+
+def test_jl103_outside_loop_or_unjitted_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda b: b)
+
+        def plain(b):
+            return b
+
+        def run(batches, n):
+            step(batches[0][:n])   # one-shot slice outside any loop
+            for b in batches:
+                plain(b[:n])       # callee is not jitted
+        """)
+    assert findings == []
+
+
+def test_jl103_suppression_comment(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda b: b)
+
+        def run(batches, n):
+            for b in batches:
+                step(b[:n])  # jaxlint: disable=JL103 -- bounded retrace
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
 # JL201 — host sync in hot loop
 # --------------------------------------------------------------------------- #
 
@@ -485,7 +568,8 @@ def test_cli_list_rules():
         capture_output=True, text=True,
     )
     assert proc.returncode == 0
-    for rule in ("JL001", "JL002", "JL101", "JL102", "JL201", "JL301"):
+    for rule in ("JL001", "JL002", "JL101", "JL102", "JL103", "JL201",
+                 "JL301", "JL302"):
         assert rule in proc.stdout
 
 

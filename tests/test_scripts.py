@@ -271,3 +271,277 @@ def test_compare_race_missing_gamma_fails_gate(tmp_path, capsys):
     assert "**VERDICT: FAIL**" in out
     assert "MISSING" in out
     assert "missing a gamma" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------- #
+# report_run: fleet merge + crash forensics
+# --------------------------------------------------------------------------- #
+
+
+def _rr():
+    return _load_script("report_run")
+
+
+def test_discover_streams_single_process_fallback(tmp_path):
+    m = _rr()
+    run = str(tmp_path / "run.jsonl")
+    _write_jsonl(run, [{"type": "run", "seed": 0}])
+    assert m.discover_process_streams(run) == {0: run}
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        merged = m.render_fleet(run)
+    # Single stream: the legacy report is unchanged — no fleet section.
+    assert buf.getvalue() == ""
+    assert list(merged) == [0]
+
+
+def test_discover_streams_finds_per_process_siblings(tmp_path):
+    m = _rr()
+    run = str(tmp_path / "run.jsonl")
+    _write_jsonl(run, [{"type": "run", "seed": 0}])
+    _write_jsonl(str(tmp_path / "run_p1.jsonl"), [{"type": "epoch"}])
+    _write_jsonl(str(tmp_path / "run_p2.jsonl"), [{"type": "epoch"}])
+    # A stray non-matching file must not be picked up.
+    _write_jsonl(str(tmp_path / "run_other.jsonl"), [{"type": "epoch"}])
+    streams = m.discover_process_streams(run)
+    assert sorted(streams) == [0, 1, 2]
+    assert streams[2].endswith("run_p2.jsonl")
+
+
+def test_load_records_tolerates_empty_and_truncated(tmp_path):
+    m = _rr()
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert m.load_records(empty)["task"] == []
+    # A run SIGKILLed mid-write leaves a torn trailing line: parse what
+    # precedes it, drop the torn tail, never raise.
+    torn = str(tmp_path / "torn.jsonl")
+    with open(torn, "w") as f:
+        f.write(json.dumps({"type": "epoch", "task_id": 0, "epoch": 1}) + "\n")
+        f.write('{"type": "task", "task_id": 0, "acc')
+    by_type = m.load_records(torn)
+    assert len(by_type["epoch"]) == 1 and by_type["task"] == []
+
+
+def test_clock_offsets_align_skewed_streams():
+    m = _rr()
+    # Process 1's wall clock runs 2.5 s ahead of process 0's: same monotonic
+    # instant, bigger ts.  offset = (ts1 - mono1) - (ts0 - mono0).
+    hb = {
+        0: {"ts": 1000.0, "mono": 50.0},
+        1: {"ts": 1002.5, "mono": 50.0},
+        2: {"ts": 990.0},  # no mono anchor: unaligned, offset 0
+    }
+    off = m.clock_offsets(hb)
+    assert off == {0: 0.0, 1: 2.5, 2: 0.0}
+    # aligned_ts puts process 1's events back on process 0's clock.
+    assert 1002.5 - off[1] == 1000.0
+    # No process-0 anchor at all -> nothing to align against.
+    assert m.clock_offsets({1: {"ts": 5.0, "mono": 1.0}}) == {1: 0.0}
+
+
+def test_render_fleet_merges_and_aligns(tmp_path):
+    m = _rr()
+    run = str(tmp_path / "run.jsonl")
+    _write_jsonl(run, [
+        {"type": "run", "seed": 0, "process_index": 0, "host_id": "hostA",
+         "ts": 100.0},
+        {"type": "epoch", "task_id": 0, "epoch": 1, "ts": 101.0},
+    ])
+    _write_jsonl(str(tmp_path / "run_p1.jsonl"), [
+        {"type": "epoch", "task_id": 0, "epoch": 1, "process_index": 1,
+         "host_id": "hostB", "ts": 103.5},
+        {"type": "fault_injected", "action": "kill", "ts": 104.0,
+         "process_index": 1},
+    ])
+    json.dump({"ts": 100.0, "mono": 10.0},
+              open(tmp_path / "heartbeat.json", "w"))
+    json.dump({"ts": 102.0, "mono": 10.0},
+              open(tmp_path / "heartbeat_p1.json", "w"))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        merged = m.render_fleet(run)
+    out = buf.getvalue()
+    assert sorted(merged) == [0, 1]
+    assert "fleet telemetry: 2 process stream(s) merged" in out
+    # Process 1's clock is +2 s skewed; its last event (ts 104.0) aligns to
+    # 102.0 on process 0's clock.
+    assert "| 1 | hostB | 2 | 1 | fault_injected | 102.000 | +2.000 |" in out
+    assert "| 0 | hostA | 2 | 0 | epoch |" in out
+
+
+def test_crash_timeline_renders_last_open_span(tmp_path):
+    m = _rr()
+    run = str(tmp_path / "run.jsonl")
+    _write_jsonl(run, [{"type": "run", "seed": 0}])
+    crash = {
+        "type": "crash_report", "ts": 200.0, "returncode": -9, "hung": False,
+        "uptime_s": 12.3, "attempt": 1, "telemetry_dir": str(tmp_path),
+        "flight_dumps": [{
+            "type": "flight_dump", "ts": 150.0, "reason": "fault:kill",
+            "pid": 4242, "process_index": 0, "process_count": 1,
+            "capacity": 256, "dropped": 3,
+            "events": [
+                {"type": "span_open", "ts": 149.0, "name": "task", "task": 1},
+                {"type": "fault_injected", "ts": 150.0, "action": "kill",
+                 "spec": "kill@task1.epoch2"},
+            ],
+            "open_spans": [{"name": "fit", "span_id": 1, "depth": 0},
+                           {"name": "task", "span_id": 2, "depth": 1}],
+            "last_open_span": "task",
+        }],
+        "heartbeats": [{"ts": 149.5, "mono": 9.5, "seq": 7, "pid": 4242}],
+        "fault_ledger": [{"spec": "kill@task1.epoch2", "action": "kill"}],
+    }
+    json.dump(crash, open(tmp_path / "crash_report.json", "w"))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.render_crash_timeline(run)
+    out = buf.getvalue()
+    assert "crash timeline" in out
+    assert "returncode=-9" in out
+    assert "fault ledger: ['kill@task1.epoch2']" in out
+    assert "open spans at death: fit > task" in out
+    assert "last open span at death: task" in out
+    assert "fault_injected [spec=kill@task1.epoch2]" in out
+
+
+def test_crash_timeline_silent_without_evidence(tmp_path):
+    m = _rr()
+    run = str(tmp_path / "run.jsonl")
+    _write_jsonl(run, [{"type": "run", "seed": 0}])
+    # No crash_report.json, and the only flight dump is a clean close:
+    # steady-state artifacts are not crashes.
+    json.dump({"type": "flight_dump", "ts": 1.0, "reason": "close",
+               "pid": 1, "events": []},
+              open(tmp_path / "flight_0.json", "w"))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        m.render_crash_timeline(run)
+    assert buf.getvalue() == ""
+    # A fatal raw dump (no supervisor report) still renders.
+    json.dump({"type": "flight_dump", "ts": 2.0, "reason": "sigterm",
+               "pid": 1, "process_index": 0, "events": [],
+               "open_spans": [], "last_open_span": None},
+              open(tmp_path / "flight_0.json", "w"))
+    with redirect_stdout(buf):
+        m.render_crash_timeline(run)
+    out = buf.getvalue()
+    assert "'sigterm'" in out and "open spans at death: none" in out
+
+
+# --------------------------------------------------------------------------- #
+# perf_gate: the pure gate() verdict logic
+# --------------------------------------------------------------------------- #
+
+_BENCH_BASE = {"step_ms": 1000.0, "fetch_overhead_ms": 20.0, "backend": "cpu",
+               "global_batch": 64, "tolerance": 0.15}
+
+
+def _bench_result(**over):
+    out = {"value": 40.0, "step_ms": 1000.0, "fetch_overhead_ms": 20.0,
+           "backend": "cpu", "global_batch": 64}
+    out.update(over)
+    return out
+
+
+def test_perf_gate_passes_within_tolerance():
+    m = _load_script("perf_gate")
+    v = m.gate(_bench_result(step_ms=1100.0), _BENCH_BASE)
+    assert v["status"] == "pass" and v["reasons"] == []
+
+
+def test_perf_gate_fails_step_regression():
+    m = _load_script("perf_gate")
+    v = m.gate(_bench_result(step_ms=1200.0), _BENCH_BASE)  # > 1000 * 1.15
+    assert v["status"] == "fail"
+    assert any("step_ms regressed" in r for r in v["reasons"])
+
+
+def test_perf_gate_fails_fetch_collapse_only_when_armed():
+    m = _load_script("perf_gate")
+    # Baseline 20 ms (armed): 3x + 5 ms = 65 ms limit.
+    v = m.gate(_bench_result(fetch_overhead_ms=80.0), _BENCH_BASE)
+    assert v["status"] == "fail"
+    assert any("fetch_overhead_ms collapsed" in r for r in v["reasons"])
+    # Baseline below the 1 ms arming threshold: the estimate is scheduler
+    # noise, any measured value passes.
+    quiet = dict(_BENCH_BASE, fetch_overhead_ms=0.0)
+    v = m.gate(_bench_result(fetch_overhead_ms=250.0), quiet)
+    assert v["status"] == "pass"
+
+
+def test_perf_gate_skips_incomparable_baseline():
+    m = _load_script("perf_gate")
+    v = m.gate(_bench_result(backend="tpu"), _BENCH_BASE)
+    assert v["status"] == "skip"
+    assert "incomparable backend" in v["reasons"][0]
+    v = m.gate(_bench_result(), {})  # no baseline entry recorded yet
+    assert v["status"] == "skip"
+
+
+def test_perf_gate_fails_broken_bench():
+    m = _load_script("perf_gate")
+    assert m.gate({"error": "boom"}, _BENCH_BASE)["status"] == "fail"
+    assert m.gate(_bench_result(value=0), _BENCH_BASE)["status"] == "fail"
+
+
+def test_perf_gate_improvement_notes_stale_baseline():
+    m = _load_script("perf_gate")
+    v = m.gate(_bench_result(step_ms=500.0), _BENCH_BASE)
+    assert v["status"] == "pass"
+    assert any("refresh the baseline" in r for r in v["reasons"])
+
+
+def test_perf_gate_cli_update_and_gate(tmp_path):
+    m = _load_script("perf_gate")
+    base = str(tmp_path / "BASELINE.json")
+    canned = json.dumps(_bench_result())
+    assert m.main(["--update-baseline", "--result", canned,
+                   "--baseline", base]) == 0
+    doc = json.load(open(base))
+    assert doc["bench_gate"]["step_ms"] == 1000.0
+    # Same numbers gate clean; a 2x regression exits non-zero.
+    assert m.main(["--result", canned, "--baseline", base]) == 0
+    slow = json.dumps(_bench_result(step_ms=2000.0))
+    assert m.main(["--result", slow, "--baseline", base]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# telemetry schema: the new forensic record types
+# --------------------------------------------------------------------------- #
+
+
+def test_schema_accepts_flight_dump_and_crash_report(tmp_path):
+    m = _load_script("check_telemetry_schema")
+    dump = {"type": "flight_dump", "ts": 1.0, "reason": "sigterm", "pid": 7,
+            "capacity": 256, "dropped": 0, "events": [], "open_spans": [],
+            "last_open_span": None, "process_index": 0, "process_count": 2,
+            "host_id": "hostA"}
+    assert m.check_record(dump, "x") == []
+    report = {"type": "crash_report", "ts": 2.0, "returncode": -9,
+              "hung": False, "attempt": 1, "uptime_s": 3.5,
+              "telemetry_dir": "/tmp/t", "flight_dumps": [dump],
+              "heartbeats": [], "fault_ledger": []}
+    assert m.check_record(report, "x") == []
+    rotated = {"type": "fault_ledger_rotated", "ts": 3.0,
+               "path": "l.jsonl", "archived": "l.jsonl.1"}
+    assert m.check_record(rotated, "x") == []
+
+
+def test_schema_accepts_process_metadata_on_any_record(tmp_path):
+    m = _load_script("check_telemetry_schema")
+    rec = {"type": "resume", "ts": 1.0, "start_task": 1,
+           "process_index": 1, "process_count": 2, "host_id": "hostB"}
+    assert m.check_record(rec, "x") == []
+    # Wrong-typed process metadata is still drift.
+    bad = dict(rec, process_index="one")
+    assert any("process_index" in e for e in m.check_record(bad, "x"))
+
+
+def test_schema_accepts_heartbeat_mono(tmp_path):
+    m = _load_script("check_telemetry_schema")
+    hb = tmp_path / "heartbeat.json"
+    hb.write_text(json.dumps({"ts": 1.0, "seq": 1, "pid": 7, "mono": 42.5,
+                              "process_index": 0}))
+    assert m.check_file(str(hb)) == []

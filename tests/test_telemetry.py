@@ -94,11 +94,14 @@ def test_span_tracer_disabled_is_noop(tmp_path):
     with tr.span("fit"):
         pass
     assert tr.completed == [] and not tr.enabled
-    # Non-zero process index: silenced even with a path.
+    # Non-zero process index: enabled, but into its own per-process file.
     tr2 = SpanTracer(str(tmp_path / "s.jsonl"), process_index=1)
     with tr2.span("fit"):
         pass
-    assert not tr2.enabled and not os.path.exists(tmp_path / "s.jsonl")
+    assert tr2.enabled and not os.path.exists(tmp_path / "s.jsonl")
+    spans = load_spans(str(tmp_path / "s_p1.jsonl"))
+    assert [s["name"] for s in spans] == ["fit"]
+    assert spans[0]["process_index"] == 1
 
 
 # --------------------------------------------------------------------------- #
@@ -149,10 +152,128 @@ def test_heartbeat_disabled_noop(tmp_path):
     hb.update(force=True, step=1)
     hb.start()
     hb.stop()
-    # Non-zero process: no file even with a path.
+    # Non-zero process: beats into its own per-process file.
     hb2 = Heartbeat(str(tmp_path / "hb.json"), process_index=3)
     hb2.update(force=True, step=1)
     assert not os.path.exists(tmp_path / "hb.json")
+    beat = json.load(open(tmp_path / "hb_p3.json"))
+    assert beat["process_index"] == 3 and beat["step"] == 1
+    assert beat["mono"] > 0  # monotonic anchor for cross-process alignment
+
+
+# --------------------------------------------------------------------------- #
+# Flight recorder
+# --------------------------------------------------------------------------- #
+
+
+def test_flight_ring_bounds_and_dump_payload(tmp_path):
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+        FlightRecorder,
+    )
+
+    path = str(tmp_path / "flight_0.json")
+    fl = FlightRecorder(path, capacity=4, process_index=0, process_count=2,
+                        host_id="hostA")
+    for i in range(10):
+        fl.record({"type": "counter", "i": i})
+    fl.span_open("fit", span_id=1, depth=0)
+    fl.span_open("task", span_id=2, depth=1, task=0)
+    payload = fl.dump("periodic")
+    assert payload is not None
+    on_disk = json.load(open(path))
+    assert on_disk == payload
+    assert payload["type"] == "flight_dump"
+    assert payload["capacity"] == 4 and len(payload["events"]) == 4
+    # span_open events count toward the ring, so 12 recorded - 4 kept.
+    assert payload["dropped"] == 8
+    assert [e["type"] for e in payload["events"]] == \
+        ["counter", "counter", "span_open", "span_open"]
+    assert payload["process_index"] == 0 and payload["process_count"] == 2
+    assert payload["host_id"] == "hostA"
+    assert [s["name"] for s in payload["open_spans"]] == ["fit", "task"]
+    assert payload["last_open_span"] == "task"
+    # Closing the inner span pops it from the open stack.
+    fl.span_close(2)
+    assert fl.dump()["last_open_span"] == "fit"
+
+
+def test_flight_fatal_dump_freezes_the_tail(tmp_path):
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+        FlightRecorder,
+    )
+
+    path = str(tmp_path / "flight_0.json")
+    fl = FlightRecorder(path, capacity=8)
+    fl.span_open("task", span_id=1, depth=0)
+    fl.record({"type": "fault_injected", "action": "kill"})
+    assert fl.fatal_dump("fault:kill")["reason"] == "fault:kill"
+    # A later cadence dump (the heartbeat daemon racing the SIGKILL) must
+    # not overwrite the forensic tail.
+    fl.record({"type": "heartbeat", "seq": 99})
+    assert fl.dump("heartbeat") is None
+    on_disk = json.load(open(path))
+    assert on_disk["reason"] == "fault:kill"
+    assert on_disk["last_open_span"] == "task"
+    assert all(e["seq"] != 99 for e in on_disk["events"]
+               if e["type"] == "heartbeat")
+
+
+def test_flight_install_uninstall_restores_hooks(tmp_path):
+    import sys
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+        FlightRecorder,
+    )
+
+    prev_hook = sys.excepthook
+    fl = FlightRecorder(str(tmp_path / "flight_0.json"))
+    fl.install()
+    assert sys.excepthook is not prev_hook
+    # The wrapped hook dumps with the exception name, then chains through.
+    sys.excepthook(ValueError, ValueError("boom"), None)
+    dumped = json.load(open(tmp_path / "flight_0.json"))
+    assert dumped["reason"] == "exception:ValueError"
+    fl.uninstall()
+    assert sys.excepthook is prev_hook
+    fl.uninstall()  # idempotent
+
+
+def test_flight_sink_tees_and_delegates(tmp_path):
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+        FlightRecorder,
+        FlightSink,
+    )
+
+    path = str(tmp_path / "run.jsonl")
+    inner = JsonlLogger(path)
+    fl = FlightRecorder(str(tmp_path / "flight_0.json"), capacity=8)
+    sink = FlightSink(inner, fl)
+    sink.log("epoch", task_id=0, epoch=1, lr=0.1)
+    # Tee: the record is durably in the jsonl AND in the crash ring.
+    rec = json.loads(open(path).read().strip())
+    assert rec["type"] == "epoch" and rec["epoch"] == 1
+    tail = fl.dump()["events"]
+    assert [e["type"] for e in tail] == ["epoch"]
+    assert tail[0]["task_id"] == 0
+    # Unknown attributes delegate to the wrapped sink.
+    assert sink.path == inner.path
+
+
+def test_two_process_streams_stay_distinct(tmp_path):
+    """Every record a (faked) 2-process fleet emits carries its emitter's
+    process_index, and the streams land in distinct per-process files."""
+    run = str(tmp_path / "run.jsonl")
+    for pi in range(2):
+        sink = JsonlLogger(run, process_index=pi, process_count=2)
+        sink.log("epoch", task_id=0, epoch=1, lr=0.1)
+        sink.log("task", task_id=0, acc1=90.0)
+    assert sorted(os.listdir(tmp_path)) == ["run.jsonl", "run_p1.jsonl"]
+    for pi, name in ((0, "run.jsonl"), (1, "run_p1.jsonl")):
+        recs = [json.loads(l) for l in open(tmp_path / name)]
+        assert len(recs) == 2
+        assert all(r["process_index"] == pi for r in recs)
+        assert all(r["process_count"] == 2 for r in recs)
+        assert all(r["host_id"] for r in recs)
 
 
 # --------------------------------------------------------------------------- #
