@@ -181,6 +181,19 @@ class CilConfig:
     # the last N telemetry events are dumped to
     # <telemetry_dir>/flight_{proc}.json on every death path
 
+    # Serving (serving/ package: artifact export + hot-swap server)
+    export_dir: Optional[str] = None  # after each task's weight alignment,
+    # freeze the inference state and AOT-export it here as a per-task
+    # serving artifact (manifest.json + task_{t:03d}/); a running
+    # serving.server hot-swaps to it at the next manifest poll
+    serve_buckets: Tuple[int, ...] = (1, 8, 32, 64)  # supported inference
+    # batch shapes; the server pads each micro-batch up to the smallest
+    # covering bucket (eval rows are independent, so padding is exact)
+    serve_skew_check: bool = False  # after each export, reload the artifact
+    # and re-evaluate every seen task's val slice through it, logging a
+    # serve_skew record against the training-side accuracy row (costs one
+    # extra eval pass per task)
+
     # ------------------------------------------------------------------ #
 
     def increments(self, nb_classes: int) -> Tuple[int, ...]:
@@ -346,12 +359,36 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="with --platform cpu: number of virtual CPU devices "
                    "(xla_force_host_platform_device_count) for testing "
                    "multi-device meshes without hardware")
+    p.add_argument("--export_dir", default=None, type=str,
+                   help="freeze + AOT-export a serving artifact here after "
+                   "each task's weight alignment (serving/artifact.py); a "
+                   "running inference server hot-swaps to it")
+    p.add_argument("--serve_buckets", default="1,8,32,64", type=str,
+                   help="comma-separated batch buckets the exported predict "
+                   "function is AOT-compiled for; the server pads each "
+                   "micro-batch to the smallest covering bucket")
+    p.add_argument("--serve_skew_check", action="store_true", default=False,
+                   help="after each export, reload the artifact and "
+                   "re-evaluate the seen val slices through it, logging a "
+                   "serve_skew record vs the training accuracy row")
     p.add_argument("--compile_cache",
                    default="~/.cache/cil_tpu/xla_cache",
                    help="persistent XLA compilation cache directory; repeat "
                    "runs and repeated task shapes then skip compilation "
                    "('' disables)")
     return p
+
+
+def parse_serve_buckets(text) -> Tuple[int, ...]:
+    """``"1,8,32,64"`` -> sorted unique positive ints (the CLI surface of
+    ``CilConfig.serve_buckets``)."""
+    try:
+        vals = sorted({int(tok) for tok in str(text).split(",") if tok.strip()})
+    except ValueError:
+        raise ValueError(f"bad --serve_buckets {text!r}; want e.g. '1,8,32,64'")
+    if not vals or vals[0] <= 0:
+        raise ValueError(f"--serve_buckets must be positive ints, got {text!r}")
+    return tuple(vals)
 
 
 def config_from_args(args: argparse.Namespace) -> CilConfig:
@@ -410,4 +447,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         heartbeat_path=args.heartbeat_path,
         heartbeat_interval_s=args.heartbeat_interval_s,
         flight_events=args.flight_events,
+        export_dir=args.export_dir,
+        serve_buckets=parse_serve_buckets(args.serve_buckets),
+        serve_skew_check=args.serve_skew_check,
     )

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import pickle
 import tarfile
+import threading
 from typing import Tuple
 
 import numpy as np
@@ -203,6 +204,28 @@ def _random_resized_crop(im, input_size: int, rng: np.random.RandomState):
     )
 
 
+# Shared decode pool: one process-wide executor instead of a fresh
+# ThreadPoolExecutor per batch.  Per-batch pools pay thread spawn/teardown on
+# every batch and, worse, under the prefetch pipeline two producer threads
+# would each churn their own pools.  The lock (import-time, so never itself
+# racy) guards only the lazy creation; after that the executor is only read,
+# and ThreadPoolExecutor.map is itself thread-safe.
+_DECODE_POOL = None
+_DECODE_POOL_LOCK = threading.Lock()
+
+
+def _decode_pool():
+    from concurrent.futures import ThreadPoolExecutor
+
+    global _DECODE_POOL
+    with _DECODE_POOL_LOCK:
+        if _DECODE_POOL is None:
+            _DECODE_POOL = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="img-decode"
+            )
+        return _DECODE_POOL
+
+
 def decode_image_batch(
     paths: np.ndarray, input_size: int, train: bool, seed: int = 0
 ) -> np.ndarray:
@@ -210,11 +233,11 @@ def decode_image_batch(
 
     Train: RandomResizedCrop (scale 0.08-1.0).  Eval: resize to
     ``256/224 * input_size`` shorter side + center crop (reference
-    ``utils.py:237-242``).  Decoding fans out over a thread pool (PIL releases
-    the GIL) — the replacement for the DataLoader worker processes.
+    ``utils.py:237-242``).  Decoding fans out over the shared module pool
+    (PIL releases the GIL) — the replacement for the DataLoader worker
+    processes.  Safe to call from multiple producer threads: the prefetch
+    pipeline and the serving skew probe share one executor.
     """
-    from concurrent.futures import ThreadPoolExecutor
-
     from PIL import Image
 
     def one(i: int) -> np.ndarray:
@@ -236,8 +259,7 @@ def decode_image_batch(
                 im = im.crop((left, top, left + input_size, top + input_size))
             return np.asarray(im, np.uint8)
 
-    with ThreadPoolExecutor(max_workers=min(16, len(paths))) as pool:
-        return np.stack(list(pool.map(one, range(len(paths)))))
+    return np.stack(list(_decode_pool().map(one, range(len(paths)))))
 
 
 def maybe_decode(x: np.ndarray, input_size: int, train: bool, seed: int = 0) -> np.ndarray:

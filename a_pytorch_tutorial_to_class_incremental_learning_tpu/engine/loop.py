@@ -186,6 +186,7 @@ class CilTrainer:
         # Reference parity: batch_size is per-device (the reference's per-GPU
         # 128, DataLoader-per-rank under DistributedSampler); the global batch
         # scales with the data axis like DDP's world_size * 128.
+        self.channels = channels  # the serving export needs the input spec
         self.global_batch_size = config.batch_size * self.mesh.shape["data"]
         self.model, variables = create_model(
             config.backbone,
@@ -494,6 +495,12 @@ class CilTrainer:
                     ),
                     **tel.matrix.summary(),
                 )
+
+                # Serving artifact: freeze the just-aligned model before the
+                # teacher snapshot mutates anything (serving/artifact.py).
+                if self.config.export_dir and jax.process_index() == 0:
+                    with tel.span("export_artifact", task=task_id):
+                        self._export_artifact(task_id, nb_new, acc_per_task)
 
                 # Teacher snapshot (template.py:290).  Copied, not aliased:
                 # the train step donates the student state's buffers, and a
@@ -966,3 +973,50 @@ class CilTrainer:
                 self.jsonl.log(
                     "ckpt_save_error", error=repr(e), task_id=task_id
                 )
+
+    # ------------------------------------------------------------------ #
+    # Serving export hook (serving/ package; --export_dir)
+    # ------------------------------------------------------------------ #
+
+    def _export_artifact(self, task_id: int, nb_new: int, acc_per_task) -> None:
+        """Freeze the post-alignment model as a serving artifact.
+
+        Same failure contract as checkpoint saves: a transient export
+        failure costs this task's artifact (the server keeps the previous
+        one), never the training run.
+        """
+        from serving.artifact import export_from_trainer
+
+        t0 = time.time()
+        try:
+            path = export_from_trainer(
+                self, task_id, known_after=self.known + nb_new,
+                acc_per_task=acc_per_task,
+            )
+        except OSError as e:
+            print(f"| serving artifact export failed: {e!r}")
+            self.jsonl.log("serve_export", task_id=task_id, error=repr(e))
+            return
+        self.jsonl.log(
+            "serve_export",
+            task_id=task_id,
+            path=path,
+            known=self.known + nb_new,
+            buckets=list(self.config.serve_buckets),
+            seconds=round(time.time() - t0, 2),
+        )
+        if self.config.serve_skew_check:
+            from serving.artifact import load_artifact
+            from serving.skew import measure_skew
+
+            try:
+                artifact = load_artifact(path)
+                measure_skew(
+                    artifact, self.scenario_val, sink=self.jsonl,
+                    train_acc_per_task=acc_per_task,
+                )
+            except OSError as e:
+                # The skew check is observability, not a gate; a reload
+                # failure is itself the signal worth logging.
+                print(f"| serve skew check failed: {e!r}")
+                self.jsonl.log("serve_export", task_id=task_id, error=repr(e))

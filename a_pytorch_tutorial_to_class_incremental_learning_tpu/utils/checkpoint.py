@@ -34,14 +34,15 @@ Two on-disk formats (``--ckpt_backend``):
 
 * ``pickle`` (default): one pickle per task of host numpy pytrees (atomic
   rename), written by process 0 only.  Fine while parameters are replicated.
-  Epoch checkpoints always use this format.
-* ``orbax``: the *device array* state (params + batch stats) goes through
-  orbax/tensorstore — every process writes its own shards and restore places
-  arrays directly onto the mesh sharding, so no device array gathers to one
-  host.  Host-side metadata (rehearsal memory, accuracy history,
-  bookkeeping) still funnels through a process-0 sidecar pickle.  A
-  checkpoint counts as complete only when both the sidecar and orbax's
-  atomically-finalized directory exist.
+* ``orbax``: the *device array* state (params + batch stats, plus momentum
+  and teacher trees at epoch granularity) goes through orbax/tensorstore —
+  every process writes its own shards and restore places arrays directly
+  onto the mesh sharding, so no device array gathers to one host.  Host-side
+  metadata (rehearsal memory, accuracy history, bookkeeping) still funnels
+  through a process-0 sidecar pickle.  A checkpoint counts as complete only
+  when both the sidecar and orbax's atomically-finalized directory exist.
+  Epoch checkpoints honour the backend too: ``task_{t}_epoch_{e}.orbax``
+  directories with the same ``.meta`` sidecar-first write order.
 
 Fault injection (``--fault_spec``): the saves call the trainer's injector at
 site ``ckpt.save`` and apply the cooperative actions — ``save_ioerror``
@@ -65,7 +66,7 @@ import numpy as np
 from ..parallel.dist import barrier, is_main_process
 
 _TASK_RE = re.compile(r"task_(\d+)\.(ckpt|orbax)")
-_EPOCH_RE = re.compile(r"task_(\d+)_epoch_(\d+)\.ckpt")
+_EPOCH_RE = re.compile(r"task_(\d+)_epoch_(\d+)\.(ckpt|orbax)")
 
 
 def _task_path(ckpt_dir: str, task_id: int, backend: str = "pickle") -> str:
@@ -73,8 +74,12 @@ def _task_path(ckpt_dir: str, task_id: int, backend: str = "pickle") -> str:
     return os.path.join(ckpt_dir, f"task_{task_id:03d}.{ext}")
 
 
-def _epoch_path(ckpt_dir: str, task_id: int, epoch: int) -> str:
-    return os.path.join(ckpt_dir, f"task_{task_id:03d}_epoch_{epoch:03d}.ckpt")
+def _epoch_path(ckpt_dir: str, task_id: int, epoch: int,
+                backend: str = "pickle") -> str:
+    ext = "orbax" if backend == "orbax" else "ckpt"
+    return os.path.join(
+        ckpt_dir, f"task_{task_id:03d}_epoch_{epoch:03d}.{ext}"
+    )
 
 
 def _to_host(tree):
@@ -181,6 +186,8 @@ def checkpoint_candidates(ckpt_dir: str) -> List[Tuple[int, Optional[int], str]]
             continue
         m = _EPOCH_RE.fullmatch(name)
         if m:
+            if m.group(3) == "orbax" and not os.path.exists(path + ".meta"):
+                continue  # incomplete: sidecar missing
             ranked.append((int(m.group(1)), float(m.group(2)), path))
     ranked.sort(key=lambda it: (it[0], it[1]), reverse=True)
     return [(t, None if e == float("inf") else int(e), p) for t, e, p in ranked]
@@ -285,6 +292,29 @@ def save_task_checkpoint(trainer, task_id: int) -> str:
     return path
 
 
+def _epoch_metadata(trainer, task_id: int, epoch: int, nb_new: int) -> dict:
+    """The host-side (non-array) half of an epoch checkpoint — shared by the
+    pickle payload and the orbax ``.meta`` sidecar."""
+    return {
+        "task_id": task_id,
+        "epoch": epoch,               # completed epochs, 1-based
+        "known": trainer.known,       # pre-task (the task is mid-flight)
+        "nb_new": nb_new,
+        "acc1s": list(trainer.acc1s),
+        "acc_matrix": [list(r) if r is not None else None
+                       for r in trainer.acc_matrix],
+        "memory_store": trainer.memory._store,
+        "config_seed": trainer.config.seed,
+        "global_step": trainer._global_step,
+        # Provenance, not state: epoch e+1's key is a pure fold of
+        # (seed, task, epoch) and its permutation a pure hash of the same
+        # triple, so the resume cursor at an epoch boundary is always 0.
+        "rng": {"root_seed": trainer.config.seed, "task_fold": task_id,
+                "next_epoch": epoch},
+        "perm_cursor": 0,
+    }
+
+
 def save_epoch_checkpoint(trainer, task_id: int, epoch: int, nb_new: int) -> str:
     """Persist mid-task state after ``epoch`` completed epochs (1-based).
 
@@ -293,14 +323,41 @@ def save_epoch_checkpoint(trainer, task_id: int, epoch: int, nb_new: int) -> str
     snapshot, the *pre-task* ``known``/``nb_new`` split, and the RNG
     provenance — everything ``load_task_checkpoint`` needs to drop the
     resumed process into ``_fit_task`` at ``start_epoch == epoch`` with
-    device state bit-identical to the uninterrupted twin's.  Always pickle
-    (process 0), even under the orbax backend: epoch checkpoints are
-    high-frequency scratch state, deleted at the next task boundary.
+    device state bit-identical to the uninterrupted twin's.
+
+    Backends mirror the task-boundary split: ``pickle`` gathers host copies
+    through process 0; ``orbax`` writes the device trees (params, batch
+    stats, momentum, teacher) through tensorstore — every process its own
+    shards — with the host metadata in a checksummed ``.meta`` sidecar,
+    landed *before* orbax's atomically-finalized directory so no crash
+    window yields a half-checkpoint that loads.
     """
     ckpt_dir = trainer.config.ckpt_dir
-    path = _epoch_path(ckpt_dir, task_id, epoch)
+    backend = trainer.config.ckpt_backend
+    path = _epoch_path(ckpt_dir, task_id, epoch, backend)
     actions = _fire_save_faults(trainer, task_id, epoch=epoch)
-    if is_main_process():
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        if is_main_process():
+            os.makedirs(ckpt_dir, exist_ok=True)
+            meta = _epoch_metadata(trainer, task_id, epoch, nb_new)
+            meta["has_teacher"] = trainer.teacher is not None
+            _write_pickle_atomic(path + ".meta", meta)
+        barrier()
+        tree = {
+            "params": trainer.state.params,
+            "batch_stats": trainer.state.batch_stats,
+            "momentum": trainer.state.momentum,
+        }
+        if trainer.teacher is not None:
+            tree["teacher_params"] = trainer.teacher.params
+            tree["teacher_batch_stats"] = trainer.teacher.batch_stats
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), tree, force=True)
+        ckptr.wait_until_finished()
+        ckptr.close()
+    elif is_main_process():
         os.makedirs(ckpt_dir, exist_ok=True)
         teacher = None
         if trainer.teacher is not None:
@@ -308,28 +365,13 @@ def save_epoch_checkpoint(trainer, task_id: int, epoch: int, nb_new: int) -> str
                 "params": _to_host(trainer.teacher.params),
                 "batch_stats": _to_host(trainer.teacher.batch_stats),
             }
-        payload = {
-            "task_id": task_id,
-            "epoch": epoch,               # completed epochs, 1-based
-            "known": trainer.known,       # pre-task (the task is mid-flight)
-            "nb_new": nb_new,
-            "acc1s": list(trainer.acc1s),
-            "acc_matrix": [list(r) if r is not None else None
-                           for r in trainer.acc_matrix],
-            "memory_store": trainer.memory._store,
-            "config_seed": trainer.config.seed,
-            "params": _to_host(trainer.state.params),
-            "batch_stats": _to_host(trainer.state.batch_stats),
-            "momentum": _to_host(trainer.state.momentum),
-            "teacher": teacher,
-            "global_step": trainer._global_step,
-            # Provenance, not state: epoch e+1's key is a pure fold of
-            # (seed, task, epoch) and its permutation a pure hash of the same
-            # triple, so the resume cursor at an epoch boundary is always 0.
-            "rng": {"root_seed": trainer.config.seed, "task_fold": task_id,
-                    "next_epoch": epoch},
-            "perm_cursor": 0,
-        }
+        payload = _epoch_metadata(trainer, task_id, epoch, nb_new)
+        payload.update(
+            params=_to_host(trainer.state.params),
+            batch_stats=_to_host(trainer.state.batch_stats),
+            momentum=_to_host(trainer.state.momentum),
+            teacher=teacher,
+        )
         _write_pickle_atomic(path, payload)
     _apply_payload_faults(actions, path)
     barrier()
@@ -337,13 +379,22 @@ def save_epoch_checkpoint(trainer, task_id: int, epoch: int, nb_new: int) -> str
 
 
 def _drop_epoch_checkpoints(ckpt_dir: str, task_id: int) -> None:
-    """The task-boundary checkpoint supersedes its task's epoch scratch."""
+    """The task-boundary checkpoint supersedes its task's epoch scratch.
+
+    Pickle epochs are a payload + ``.sha256``; orbax epochs are a directory
+    + ``.meta`` pickle + ``.meta.sha256``."""
+    import shutil
+
     if not os.path.isdir(ckpt_dir):
         return
     for name in os.listdir(ckpt_dir):
         m = _EPOCH_RE.fullmatch(name)
         if m and int(m.group(1)) == task_id:
-            for victim in (name, name + ".sha256"):
+            target = os.path.join(ckpt_dir, name)
+            if os.path.isdir(target):
+                shutil.rmtree(target, ignore_errors=True)
+            for victim in (name, name + ".sha256",
+                           name + ".meta", name + ".meta.sha256"):
                 try:
                     os.remove(os.path.join(ckpt_dir, victim))
                 except OSError:
@@ -538,23 +589,77 @@ def _restore_epoch(trainer, path: str, payload: dict) -> bool:
     copy_in = lambda tree: jax.tree_util.tree_map(  # noqa: E731
         jnp.copy, shard_params(trainer.mesh, tree)
     )
-    # Same re-homing rule as the task branch: unpickled host buffers must
-    # never reach the donating train programs (zero-copy device_put aliasing).
-    params = copy_in(payload["params"])
-    batch_stats = copy_in(payload["batch_stats"])
-    momentum = copy_in(payload["momentum"])
-    if getattr(trainer.config, "check_donation", False):
-        from analysis.runtime import assert_unaliased, poison_host_tree
-
-        host_state = {k: payload[k] for k in ("params", "batch_stats", "momentum")}
-        assert_unaliased(
-            host_state,
-            {"params": params, "batch_stats": batch_stats, "momentum": momentum},
-            where=path,
-        )
-        poison_host_tree(host_state)
     known = int(payload["known"])
     nb_new = int(payload["nb_new"])
+    if path.endswith(".orbax"):
+        import orbax.checkpoint as ocp
+
+        # Restore straight onto the mesh sharding — the static full-width
+        # head keeps every array shape constant across tasks (and mid-task),
+        # so the freshly-initialized live state is its own restore template.
+        spec = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype, sharding=a.sharding
+        )
+        as_spec = lambda tree: jax.tree_util.tree_map(spec, tree)  # noqa: E731
+        template = {
+            "params": as_spec(trainer.state.params),
+            "batch_stats": as_spec(trainer.state.batch_stats),
+            "momentum": as_spec(trainer.state.momentum),
+        }
+        if payload["has_teacher"]:
+            template["teacher_params"] = as_spec(trainer.state.params)
+            template["teacher_batch_stats"] = as_spec(trainer.state.batch_stats)
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path), template)
+        ckptr.close()
+        # Same re-homing copy as every other restore path: restored arrays
+        # can alias checkpoint-reader buffers the donating programs must
+        # never be handed.
+        rehome = lambda tree: jax.tree_util.tree_map(jnp.copy, tree)  # noqa: E731
+        params = rehome(restored["params"])
+        batch_stats = rehome(restored["batch_stats"])
+        momentum = rehome(restored["momentum"])
+        teacher_trees = None
+        if payload["has_teacher"]:
+            teacher_trees = (
+                rehome(restored["teacher_params"]),
+                rehome(restored["teacher_batch_stats"]),
+            )
+        if getattr(trainer.config, "check_donation", False):
+            from analysis.runtime import assert_unaliased
+
+            assert_unaliased(
+                restored,
+                {"params": params, "batch_stats": batch_stats,
+                 "momentum": momentum},
+                where=path,
+            )
+    else:
+        # Same re-homing rule as the task branch: unpickled host buffers must
+        # never reach the donating train programs (zero-copy device_put
+        # aliasing).
+        params = copy_in(payload["params"])
+        batch_stats = copy_in(payload["batch_stats"])
+        momentum = copy_in(payload["momentum"])
+        if getattr(trainer.config, "check_donation", False):
+            from analysis.runtime import assert_unaliased, poison_host_tree
+
+            host_state = {
+                k: payload[k] for k in ("params", "batch_stats", "momentum")
+            }
+            assert_unaliased(
+                host_state,
+                {"params": params, "batch_stats": batch_stats,
+                 "momentum": momentum},
+                where=path,
+            )
+            poison_host_tree(host_state)
+        teacher_trees = None
+        if payload["teacher"] is not None:
+            teacher_trees = (
+                copy_in(payload["teacher"]["params"]),
+                copy_in(payload["teacher"]["batch_stats"]),
+            )
     trainer.state = trainer.state.replace(
         params=params,
         batch_stats=batch_stats,
@@ -562,10 +667,10 @@ def _restore_epoch(trainer, path: str, payload: dict) -> bool:
         num_active=replicated_scalar(trainer.mesh, known + nb_new),
         known=replicated_scalar(trainer.mesh, known),
     )
-    if payload["teacher"] is not None:
+    if teacher_trees is not None:
         trainer.teacher = Teacher(
-            params=copy_in(payload["teacher"]["params"]),
-            batch_stats=copy_in(payload["teacher"]["batch_stats"]),
+            params=teacher_trees[0],
+            batch_stats=teacher_trees[1],
             known=replicated_scalar(trainer.mesh, known),
         )
     else:

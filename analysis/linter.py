@@ -22,6 +22,9 @@ from .rules import ProjectIndex, run_rules
 
 DEFAULT_TARGETS = (
     "a_pytorch_tutorial_to_class_incremental_learning_tpu",
+    "analysis",
+    "faults",
+    "serving",
     "scripts",
     "bench.py",
     "train.py",

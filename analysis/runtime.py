@@ -109,7 +109,7 @@ def _leaf_pointers(x) -> Set[int]:
         for s in shards:
             try:
                 ptrs.add(s.data.unsafe_buffer_pointer())
-            except Exception:  # noqa: BLE001 - non-addressable/deleted shard
+            except Exception:  # noqa: BLE001  # jaxlint: disable=JL302 -- non-addressable or deleted shard has no pointer; an absent entry is the designed answer
                 pass
     return ptrs
 

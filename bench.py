@@ -363,6 +363,138 @@ def measure_step_path(batch_size: int, epochs: int, depths, steps_cap: int) -> d
     }
 
 
+def measure_serve(duration_s: float = 4.0, workers: int = 8,
+                  buckets=(1, 8, 32), max_wait_ms: float = 3.0,
+                  open_rps: float = 100.0) -> dict:
+    """Serving load harness: export one artifact, drive the batched server.
+
+    Two traffic shapes against the same server:
+
+    * **closed-loop** — ``workers`` threads each submit-and-wait in a tight
+      loop for ``duration_s``; measures saturated throughput (the batcher
+      should fill large buckets) and the latency distribution under it.
+    * **open-loop** — requests arrive on a fixed ``open_rps`` clock whether
+      or not earlier ones finished, the shape that exposes queueing delay a
+      closed loop hides; percentiles come from the per-request latencies.
+
+    The headline ``value`` is closed-loop req/s; ``p99_ms`` (closed-loop,
+    per-request latencies after the ramp) is what ``perf_gate.py --serve``
+    gates.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+        AugmentConfig,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+        grow,
+    )
+    from serving import InferenceServer, export_artifact
+
+    nb = 20
+    buckets = tuple(sorted({int(b) for b in buckets}))
+    export_dir = tempfile.mkdtemp(prefix="cil_serve_bench_")
+    try:
+        model, variables = create_model("resnet20", nb)
+        variables = grow(variables, jax.random.PRNGKey(0), 0, nb)
+        aug = AugmentConfig()
+        t0 = time.perf_counter()
+        export_artifact(
+            export_dir, 0, model, aug,
+            variables["params"], variables["batch_stats"],
+            known=nb, class_order=list(range(nb)),
+            input_size=32, channels=3, buckets=buckets,
+        )
+        export_s = time.perf_counter() - t0
+        server = InferenceServer(export_dir, max_wait_ms=max_wait_ms).start()
+        try:
+            rng = np.random.RandomState(0)
+            img = rng.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+            # Warmup: every bucket's executable gets one dispatch before
+            # anything is timed.
+            for f in [server.submit(img) for _ in range(max(buckets))]:
+                f.result(timeout=60)
+
+            # Closed loop.  Percentiles come from per-request latencies with
+            # the ramp excluded: the first fraction of the window measures
+            # queue buildup while the workers outpace a cold batcher, which
+            # made raw p99 swing ~60% run to run.
+            ramp_s = min(1.0, duration_s / 4)
+            t0 = time.perf_counter()
+            stop_at = t0 + duration_s
+            counts = [0] * workers
+            lat_per_worker = [[] for _ in range(workers)]
+
+            def closed(w: int) -> None:
+                while time.perf_counter() < stop_at:
+                    res = server.submit(img).result(timeout=60)
+                    counts[w] += 1
+                    if time.perf_counter() - t0 > ramp_s:
+                        lat_per_worker[w].append(res["latency_ms"])
+
+            threads = [threading.Thread(target=closed, args=(w,))
+                       for w in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            closed_wall = time.perf_counter() - t0
+            closed_n = sum(counts)
+            closed_lat = np.asarray(
+                [ms for lats in lat_per_worker for ms in lats], np.float64
+            )
+            closed_stats = server.stats()
+
+            # Open loop: fixed arrival clock, latencies from the responses.
+            futs = []
+            period = 1.0 / max(open_rps, 1e-9)
+            open_until = time.perf_counter() + duration_s / 2
+            next_t = time.perf_counter()
+            while time.perf_counter() < open_until:
+                futs.append(server.submit(img))
+                next_t += period
+                pause = next_t - time.perf_counter()
+                if pause > 0:
+                    time.sleep(pause)
+            open_lat = np.asarray(
+                [f.result(timeout=60)["latency_ms"] for f in futs], np.float64
+            )
+        finally:
+            server.stop()
+        result = {
+            "metric": "serve_throughput",
+            "value": round(closed_n / closed_wall, 1),
+            "unit": "req/s",
+            "p50_ms": round(float(np.percentile(closed_lat, 50)), 3),
+            "p95_ms": round(float(np.percentile(closed_lat, 95)), 3),
+            "p99_ms": round(float(np.percentile(closed_lat, 99)), 3),
+            "open_rps": open_rps,
+            "open_p50_ms": round(float(np.percentile(open_lat, 50)), 3),
+            "open_p99_ms": round(float(np.percentile(open_lat, 99)), 3),
+            "open_n": int(open_lat.size),
+            "bucket_occupancy": closed_stats["bucket_occupancy"],
+            "bucket_counts": {str(k): v for k, v in
+                              sorted(closed_stats["bucket_counts"].items())},
+            "buckets": list(buckets),
+            "max_wait_ms": max_wait_ms,
+            "workers": workers,
+            "served": closed_stats["served"],
+            "failed": closed_stats["failed"],
+            "export_s": round(export_s, 1),
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "host_id": socket.gethostname(),
+        }
+        return result
+    finally:
+        shutil.rmtree(export_dir, ignore_errors=True)
+
+
 def measure(batch_size: int, iters: int, compute_dtype: str, fused_n: int,
             with_bf16: bool) -> dict:
     import jax
@@ -484,7 +616,9 @@ def measure(batch_size: int, iters: int, compute_dtype: str, fused_n: int,
 def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
          fused_n: int = 7000, with_bf16: bool = True, cpu_full: bool = False,
          step_path: bool = False, prefetch_depths=(0, 2, 4),
-         step_path_epochs: int = 3, step_path_steps: int = 8):
+         step_path_epochs: int = 3, step_path_steps: int = 8,
+         serve: bool = False, serve_duration_s: float = 4.0,
+         serve_buckets=(1, 8, 32), serve_max_wait_ms: float = 3.0):
     """``batch_size`` defaults to 512 — the reference's *global* batch
     (4 GPUs x 128), which fits comfortably on one v5e chip; a multi-chip mesh
     would use the per-device 128 of the config instead.
@@ -493,6 +627,10 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
     benchmark: the same epoch at prefetch depths ``prefetch_depths``,
     reporting per-depth img/s and ``fetch_overhead_ms`` (residual host
     time the ring buffer failed to overlap).
+
+    ``serve=True`` switches to the serving load harness: export one
+    artifact, drive the micro-batching server closed- and open-loop,
+    report req/s + latency percentiles + bucket occupancy.
     """
     backend = probe_backend()
     reduced = False
@@ -512,7 +650,13 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
                 with_bf16 = False
                 step_path_epochs = min(step_path_epochs, 2)
                 step_path_steps = min(step_path_steps, 6)
-        if step_path:
+                serve_duration_s = min(serve_duration_s, 3.0)
+        if serve:
+            result = measure_serve(
+                duration_s=serve_duration_s, buckets=tuple(serve_buckets),
+                max_wait_ms=serve_max_wait_ms,
+            )
+        elif step_path:
             result = measure_step_path(
                 batch_size, step_path_epochs, tuple(prefetch_depths),
                 step_path_steps,
@@ -524,10 +668,11 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
             result["reduced_cpu_fallback"] = True
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
         result = {
-            "metric": "step_path_prefetch" if step_path
-            else "train_step_throughput",
+            "metric": ("serve_throughput" if serve
+                       else "step_path_prefetch" if step_path
+                       else "train_step_throughput"),
             "value": 0.0,
-            "unit": "img/s",
+            "unit": "req/s" if serve else "img/s",
             "vs_baseline": 0.0,
             "backend": backend,
             "error": f"{type(e).__name__}: {e}",
@@ -559,8 +704,20 @@ if __name__ == "__main__":
                    help="timed epochs per depth for --step_path")
     p.add_argument("--step_path_steps", type=int, default=8,
                    help="steps per epoch cap for --step_path")
+    p.add_argument("--serve", action="store_true",
+                   help="benchmark the inference server (serving/) instead "
+                   "of the train step: req/s + latency percentiles")
+    p.add_argument("--serve_duration_s", type=float, default=4.0,
+                   help="closed-loop traffic duration for --serve")
+    p.add_argument("--serve_buckets", default="1,8,32",
+                   help="comma-separated batch buckets for --serve")
+    p.add_argument("--serve_max_wait_ms", type=float, default=3.0,
+                   help="micro-batch max-wait deadline for --serve")
     a = p.parse_args()
     main(a.batch_size, a.iters, a.compute_dtype, a.fused_n, not a.no_bf16,
          a.cpu_full, a.step_path,
          tuple(int(d) for d in a.prefetch_depths.split(",")),
-         a.step_path_epochs, a.step_path_steps)
+         a.step_path_epochs, a.step_path_steps,
+         a.serve, a.serve_duration_s,
+         tuple(int(b) for b in a.serve_buckets.split(",")),
+         a.serve_max_wait_ms)

@@ -20,6 +20,8 @@ Spec grammar (comma-separated clauses)::
     corrupt_ckpt@task2         bit-flip the first checkpoint saved for task 2
     truncate_ckpt@task1.epoch2 truncate that epoch checkpoint's payload
     save_ioerror@task0         transient OSError on task 0's checkpoint save
+    swap_ioerror@task1         the serving hot-swap TO task 1's artifact fails
+    slow_swap@task1            that swap stalls for slow_s before loading
 
 Coordinates use the run-log numbering: ``task`` is the 0-based ``task_id``,
 ``epoch``/``step`` are 1-based like the ``epoch`` records.  Unspecified
@@ -65,6 +67,8 @@ from typing import Dict, List, Optional, Tuple
 #                                              coords: task, epoch, step
 #   ckpt.save      utils/checkpoint.py, before/after each checkpoint write
 #                                              coords: task[, epoch]
+#   serve.swap     serving/server.py, before the watcher applies a manifest
+#                  hot-swap                    coords: task (the swap TARGET)
 ACTIONS: Dict[str, frozenset] = {
     "kill": frozenset({"engine.epoch", "engine.step"}),
     "raise": frozenset({"engine.epoch", "engine.step"}),
@@ -73,11 +77,16 @@ ACTIONS: Dict[str, frozenset] = {
     "corrupt_ckpt": frozenset({"ckpt.save"}),
     "truncate_ckpt": frozenset({"ckpt.save"}),
     "save_ioerror": frozenset({"ckpt.save"}),
+    "swap_ioerror": frozenset({"serve.swap"}),
+    "slow_swap": frozenset({"serve.swap"}),
 }
 
 # Actions fire() performs itself vs. actions the call site must apply (a
-# checkpoint file can only be corrupted by the code that knows its path).
-COOPERATIVE = frozenset({"corrupt_ckpt", "truncate_ckpt", "save_ioerror"})
+# checkpoint file can only be corrupted by the code that knows its path;
+# a swap can only be failed by the server that owns the swap).
+COOPERATIVE = frozenset({
+    "corrupt_ckpt", "truncate_ckpt", "save_ioerror", "swap_ioerror",
+})
 
 # step nests inside epoch (a step coordinate without its epoch is ambiguous
 # across epochs, so the grammar forbids it).
@@ -220,7 +229,7 @@ class FaultInjector:
                 os.kill(os.getpid(), signal.SIGKILL)
             elif clause.action in ("raise", "producer_die"):
                 raise FaultInjected(clause, site, coords)
-            elif clause.action == "slow_batch":
+            elif clause.action in ("slow_batch", "slow_swap"):
                 time.sleep(self.slow_s)
             else:
                 cooperative.append(clause.action)

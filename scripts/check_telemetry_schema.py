@@ -182,6 +182,43 @@ SCHEMA = {
         },
         None,
     ),
+    # Serving (serving/ + engine/loop.py export hook).  One serve_export per
+    # task with --export_dir: either the artifact landed (path/known/...) or
+    # the export failed and training continued (error).
+    "serve_export": (
+        {"task_id": NUM},
+        {"path": str, "known": NUM, "buckets": list, "seconds": NUM,
+         "error": str},
+        None,
+    ),
+    # A successful artifact (hot-)swap; from_task is None for the initial
+    # load at server start.
+    "serve_swap": (
+        {"from_task": (int, float, type(None)), "to_task": NUM,
+         "load_ms": NUM, "compile_ms": NUM, "path": str},
+        {},
+        None,
+    ),
+    # A swap attempt failed (corrupt artifact, injected IOError): the server
+    # kept the current artifact and will retry at the next manifest poll.
+    "serve_swap_failed": ({"task_id": NUM, "error": str}, {}, None),
+    # Training/serving skew (serving/skew.py): accuracy re-measured through
+    # the exported artifact vs the trainer's accuracy row.  Zero skew is the
+    # healthy state — the exported program is the same computation.
+    "serve_skew": (
+        {"task_id": NUM, "served_acc1": NUM, "served_acc_per_task": list,
+         "n": NUM},
+        {"train_acc_per_task": (list, type(None)),
+         "skew_abs_max": (int, float, type(None))},
+        None,
+    ),
+    # Rolling latency window from the inference server's batcher.
+    "serve_latency": (
+        {"count": NUM, "p50_ms": NUM, "p95_ms": NUM, "p99_ms": NUM,
+         "throughput_rps": NUM},
+        {"bucket_occupancy": NUM, "batches": NUM, "task_id": NUM},
+        None,
+    ),
 }
 
 # Every JsonlLogger record carries a writer timestamp; spans/heartbeats

@@ -4,6 +4,7 @@
 Usage: python scripts/perf_gate.py                  # gate (ci.sh stage)
        python scripts/perf_gate.py --update-baseline  # (re)record the entry
        python scripts/perf_gate.py --result '<json>'  # gate a canned result
+       python scripts/perf_gate.py --serve             # serving-latency gate
 
 Runs ``bench.py`` (the CPU reduced fallback under ``JAX_PLATFORMS=cpu``:
 batch 64, 5 iters — ~30 s with a warm compile cache), parses its single JSON
@@ -22,6 +23,14 @@ line, and compares against the ``bench_gate`` entry in ``BASELINE.json``:
 * A bench error / zero value always fails — a broken bench must not read as
   "no regression".
 
+``--serve`` gates the serving path instead: ``bench.py --serve`` (the
+micro-batching inference server over an exported artifact) against the
+``serve_gate`` baseline entry.  The hard gate is closed-loop ``p99_ms`` —
+tail latency is the serving SLO, and a batcher bug (lost wakeup, lock held
+across dispatch) shows up there long before mean throughput moves.  A
+baseline from a different backend, bucket set, or max-wait is incomparable
+and SKIPs, same rule as the train gate.
+
 Exit 0 on pass/skip, 1 on fail, one JSON verdict line either way.
 """
 
@@ -38,16 +47,19 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _BASELINE = os.path.join(_REPO, "BASELINE.json")
 
 DEFAULT_TOLERANCE = 0.15
+# The serve gate's p99 tolerance rides the same 15% headroom; run-to-run p99
+# noise beyond it means the batcher, not the scheduler, changed behaviour.
+SERVE_TOLERANCE = 0.15
 FETCH_FACTOR = 3.0   # loose multiplicative gate for fetch_overhead_ms
 FETCH_SLACK_MS = 5.0  # absolute slack on top of the factor
 FETCH_ARM_MS = 1.0   # the fetch gate arms only at a meaningful baseline
 
 
-def run_bench(timeout_s: float = 600.0) -> dict:
+def run_bench(timeout_s: float = 600.0, extra_args=()) -> dict:
     """Run bench.py on CPU and parse the last JSON line of its stdout."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "bench.py")],
+        [sys.executable, os.path.join(_REPO, "bench.py"), *extra_args],
         cwd=_REPO, env=env, capture_output=True, text=True,
         timeout=timeout_s,
     )
@@ -110,6 +122,45 @@ def gate(result: dict, baseline: dict) -> dict:
     return {"status": "fail" if reasons else "pass", "reasons": reasons}
 
 
+def gate_serve(result: dict, baseline: dict) -> dict:
+    """Serving gate: closed-loop p99_ms vs the ``serve_gate`` entry."""
+    if result.get("error") or not result.get("value"):
+        return {"status": "fail",
+                "reasons": [f"serve bench did not produce a valid "
+                            f"measurement: {result.get('error', 'value=0')}"]}
+    if result.get("failed"):
+        # Failed requests are a correctness bug, not a perf data point.
+        return {"status": "fail",
+                "reasons": [f"{result['failed']} request(s) failed during "
+                            "the serve bench"]}
+    for key in ("backend", "buckets", "max_wait_ms"):
+        if baseline.get(key) is not None and result.get(key) != baseline[key]:
+            return {"status": "skip",
+                    "reasons": [f"incomparable {key}: baseline "
+                                f"{baseline[key]!r} vs measured "
+                                f"{result.get(key)!r} — refresh the baseline "
+                                "on this machine (--serve --update-baseline)"]}
+    tol = baseline.get("tolerance", DEFAULT_TOLERANCE)
+    base_p99 = baseline.get("p99_ms")
+    p99 = result.get("p99_ms")
+    if base_p99 is None or p99 is None:
+        return {"status": "skip",
+                "reasons": ["no p99_ms to compare (baseline entry missing — "
+                            "record one with --serve --update-baseline)"]}
+    reasons = []
+    limit = base_p99 * (1.0 + tol)
+    if p99 > limit:
+        reasons.append(
+            f"serve p99_ms regressed: {p99:.1f} > {limit:.1f} "
+            f"(baseline {base_p99:.1f} + {tol:.0%})")
+    if not reasons and p99 < base_p99 * (1.0 - tol):
+        reasons.append(
+            f"note: serve p99_ms improved {base_p99:.1f} -> {p99:.1f}; "
+            "refresh the baseline to tighten the gate")
+        return {"status": "pass", "reasons": reasons}
+    return {"status": "fail" if reasons else "pass", "reasons": reasons}
+
+
 def load_baseline(path: str = _BASELINE) -> dict:
     try:
         with open(path) as f:
@@ -118,18 +169,32 @@ def load_baseline(path: str = _BASELINE) -> dict:
         return {}
 
 
-def update_baseline(result: dict, path: str = _BASELINE) -> dict:
+def update_baseline(result: dict, path: str = _BASELINE,
+                    serve: bool = False) -> dict:
     doc = load_baseline(path)
-    entry = {
-        "step_ms": result.get("step_ms"),
-        "fetch_overhead_ms": result.get("fetch_overhead_ms"),
-        "backend": result.get("backend"),
-        "global_batch": result.get("global_batch"),
-        "img_s": result.get("value"),
-        "tolerance": DEFAULT_TOLERANCE,
-        "recorded_ts": round(time.time(), 3),
-    }
-    doc["bench_gate"] = entry
+    if serve:
+        entry = {
+            "p99_ms": result.get("p99_ms"),
+            "p50_ms": result.get("p50_ms"),
+            "req_s": result.get("value"),
+            "backend": result.get("backend"),
+            "buckets": result.get("buckets"),
+            "max_wait_ms": result.get("max_wait_ms"),
+            "tolerance": SERVE_TOLERANCE,
+            "recorded_ts": round(time.time(), 3),
+        }
+        doc["serve_gate"] = entry
+    else:
+        entry = {
+            "step_ms": result.get("step_ms"),
+            "fetch_overhead_ms": result.get("fetch_overhead_ms"),
+            "backend": result.get("backend"),
+            "global_batch": result.get("global_batch"),
+            "img_s": result.get("value"),
+            "tolerance": DEFAULT_TOLERANCE,
+            "recorded_ts": round(time.time(), 3),
+        }
+        doc["bench_gate"] = entry
     with open(path, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
@@ -141,6 +206,9 @@ def main(argv=None) -> int:
     p.add_argument("--update-baseline", action="store_true",
                    help="run bench.py and write its numbers as the new "
                    "bench_gate entry instead of gating")
+    p.add_argument("--serve", action="store_true",
+                   help="gate the serving bench (bench.py --serve) against "
+                   "the serve_gate entry instead of the train step")
     p.add_argument("--result", default=None,
                    help="gate this JSON result instead of running bench.py "
                    "(tests / canned measurements)")
@@ -148,21 +216,30 @@ def main(argv=None) -> int:
                    help="path to BASELINE.json")
     args = p.parse_args(argv)
 
-    result = (json.loads(args.result) if args.result else run_bench())
+    extra = ("--serve",) if args.serve else ()
+    result = (json.loads(args.result) if args.result
+              else run_bench(extra_args=extra))
+    entry_key = "serve_gate" if args.serve else "bench_gate"
     if args.update_baseline:
-        entry = update_baseline(result, args.baseline)
+        entry = update_baseline(result, args.baseline, serve=args.serve)
         print(json.dumps({"metric": "perf_gate", "status": "updated",
-                          "bench_gate": entry}))
+                          entry_key: entry}))
         return 0 if not result.get("error") else 1
-    baseline = load_baseline(args.baseline).get("bench_gate", {})
-    verdict = gate(result, baseline)
+    baseline = load_baseline(args.baseline).get(entry_key, {})
+    if args.serve:
+        verdict = gate_serve(result, baseline)
+        measured_keys = ("p99_ms", "p50_ms", "value", "failed", "backend",
+                         "buckets", "max_wait_ms")
+    else:
+        verdict = gate(result, baseline)
+        measured_keys = ("step_ms", "fetch_overhead_ms", "value", "backend",
+                         "global_batch")
     print(json.dumps({
         "metric": "perf_gate",
+        "gate": entry_key,
         "status": verdict["status"],
         "reasons": verdict["reasons"],
-        "measured": {k: result.get(k) for k in
-                     ("step_ms", "fetch_overhead_ms", "value", "backend",
-                      "global_batch")},
+        "measured": {k: result.get(k) for k in measured_keys},
         "baseline": baseline or None,
     }))
     return 1 if verdict["status"] == "fail" else 0

@@ -28,7 +28,9 @@ span file next to it, auto-discovered when not given):
   anchors,
 * crash timeline: the supervisor's ``crash_report.json`` (or raw
   ``flight_*.json`` dumps) rendered as each process's last-events tail,
-  ending with the span that was still open when it died.
+  ending with the span that was still open when it died,
+* serving: artifact exports, the hot-swap timeline (including failed
+  swaps), latency percentile windows, and training/serving skew.
 """
 
 from __future__ import annotations
@@ -149,6 +151,79 @@ def render_recompiles(recompiles, warnings_):
             f"{'yes' if r.get('expected') else '**NO**'} |"
         )
     print()
+
+
+def render_serve(by_type):
+    """Serving panel: artifact exports, the swap timeline, latency windows,
+    and training/serving skew — rendered from whichever of the serve_*
+    record types this log carries (a training log has exports + skew, a
+    server log has swaps + latency)."""
+    exports = by_type["serve_export"]
+    swaps = by_type["serve_swap"] + by_type["serve_swap_failed"]
+    latency = by_type["serve_latency"]
+    skew = by_type["serve_skew"]
+    if not (exports or swaps or latency or skew):
+        return
+    print("## serving\n")
+    if exports:
+        ok = [e for e in exports if not e.get("error")]
+        failed = [e for e in exports if e.get("error")]
+        print(f"artifact exports: {len(ok)} ok, {len(failed)} failed\n")
+        print("| task | known | buckets | seconds | error |")
+        print("|---|---|---|---|---|")
+        for e in exports:
+            print(
+                f"| {e.get('task_id', '?')} | {e.get('known', '—')} | "
+                f"{','.join(str(b) for b in e.get('buckets', [])) or '—'} | "
+                f"{e.get('seconds', '—')} | {e.get('error', '—')} |"
+            )
+        print()
+    if swaps:
+        print("swap timeline:\n")
+        print("| ts | event | task | load ms | compile ms |")
+        print("|---|---|---|---|---|")
+        for s in sorted(swaps, key=lambda r: r.get("ts", 0)):
+            if s.get("type") == "serve_swap":
+                frm = s.get("from_task")
+                label = ("initial load" if frm is None
+                         else f"swap {frm} -> {s.get('to_task')}")
+                print(
+                    f"| {s.get('ts', '?')} | {label} | {s.get('to_task')} | "
+                    f"{s.get('load_ms', 0):.0f} | {s.get('compile_ms', 0):.0f} |"
+                )
+            else:
+                print(
+                    f"| {s.get('ts', '?')} | **swap FAILED** "
+                    f"({s.get('error', '?')}) | {s.get('task_id')} | — | — |"
+                )
+        print()
+    if latency:
+        print("latency windows:\n")
+        print("| task | n | p50 ms | p95 ms | p99 ms | req/s | occupancy |")
+        print("|---|---|---|---|---|---|---|")
+        for rec in latency:
+            print(
+                f"| {rec.get('task_id', '?')} | {rec.get('count', '?')} | "
+                f"{rec.get('p50_ms', 0):.2f} | {rec.get('p95_ms', 0):.2f} | "
+                f"{rec.get('p99_ms', 0):.2f} | "
+                f"{rec.get('throughput_rps', 0):.1f} | "
+                f"{rec.get('bucket_occupancy', 0):.3f} |"
+            )
+        print()
+    if skew:
+        print("training/serving skew (served artifact vs training row):\n")
+        print("| task | served acc1 | skew abs max | n |")
+        print("|---|---|---|---|")
+        for rec in skew:
+            sk = rec.get("skew_abs_max")
+            cell = f"{sk:.5f}" if sk is not None else "—"
+            flag = " **NONZERO**" if sk else ""
+            print(
+                f"| {rec.get('task_id', '?')} | "
+                f"{rec.get('served_acc1', 0):.2f} | {cell}{flag} | "
+                f"{rec.get('n', '?')} |"
+            )
+        print()
 
 
 def render_hbm(hbm):
@@ -516,6 +591,7 @@ def main(run_path: str, second_path: str | None = None):
         print("(no completed tasks in this log)\n")
     render_stalls(by_type["epoch"])
     render_recompiles(by_type["recompile"], by_type["recompile_warning"])
+    render_serve(by_type)
     render_hbm(by_type["hbm"])
     render_fleet(run_path)
     if spans_path is None:

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""CPU serving smoke: train → export → serve → hot-swap under fire.
+
+The acceptance proof for the serving subsystem, end to end:
+
+1. Run a tiny 2-task synthetic protocol with ``--export_dir``: the trainer
+   freezes + AOT-exports an artifact after each task's weight alignment
+   (plus a ``serve_skew`` self-check through the reloaded artifact).
+2. Stage a serving directory containing only task 0 and start an
+   ``InferenceServer`` over it with ``swap_ioerror@task1`` armed, driving
+   continuous traffic from a client thread.
+3. Publish task 1 into the serving directory mid-traffic.  The first swap
+   attempt hits the injected IOError: the server must emit
+   ``serve_swap_failed`` and KEEP serving task 0 — graceful degradation,
+   zero dropped requests.  The clause is one-shot, so the next manifest
+   poll swaps cleanly and responses flip to task 1.
+4. Assert the bit-identity contract both ways: every bucket's exported
+   program reproduces a freshly rebuilt flax model's logits exactly (pre-
+   and post-swap artifacts), and a quiet-server response matches the direct
+   call for the same image.
+5. Assert zero traces on the serving hot path (``trace_count() == 0`` —
+   queries only ever run AOT-compiled executables) and that every telemetry
+   file the run produced passes the schema lint.
+
+Exit 0 when all of it holds, 1 otherwise, one JSON line either way.
+Used by ``scripts/ci.sh``; runnable standalone from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_PROTO = [
+    "--platform", "cpu",
+    "--data_set", "synthetic10",
+    "--num_bases", "0",
+    "--increment", "5",
+    "--backbone", "resnet20",
+    "--batch_size", "16",
+    "--num_epochs", "1",
+    "--eval_every_epoch", "100",
+    "--memory_size", "40",
+    "--lr", "0.05",
+    "--aa", "none",
+    "--color_jitter", "0.0",
+    "--seed", "7",
+    "--no_fused_epochs",
+    "--serve_buckets", "1,8",
+    "--serve_skew_check",
+    "--compile_cache", os.path.join(_REPO, "tests", ".jax_cache"),
+]
+
+
+def _records(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="serve_smoke_") as tmp:
+        export_dir = os.path.join(tmp, "export")
+        train_log = os.path.join(tmp, "train.jsonl")
+        train_cmd = [sys.executable, os.path.join(_REPO, "train.py"),
+                     *_PROTO, "--export_dir", export_dir,
+                     "--log_file", train_log]
+        train = subprocess.run(train_cmd, cwd=_REPO, timeout=900)
+        if train.returncode != 0:
+            print(json.dumps({"metric": "serve_smoke", "ok": False,
+                              "failures":
+                              [f"train run failed rc={train.returncode}"]}))
+            return 1
+
+        # The trainer must have exported both tasks and self-checked skew.
+        train_recs = _records(train_log)
+        exports = [r for r in train_recs if r.get("type") == "serve_export"
+                   and not r.get("error")]
+        if len(exports) != 2:
+            failures.append(f"expected 2 serve_export records, got {exports}")
+        skews = [r for r in train_recs if r.get("type") == "serve_skew"]
+        if len(skews) != 2 or any(s.get("skew_abs_max") not in (0, 0.0)
+                                  for s in skews):
+            failures.append(
+                f"serve_skew must report exactly-zero skew per task: {skews}")
+
+        # Late imports: force_platform must happen via train.py's children
+        # only; this process configures JAX itself.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (  # noqa: E501
+            JsonlLogger,
+        )
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (  # noqa: E501
+            force_platform,
+        )
+        from faults.injector import FaultInjector, parse_fault_spec
+        from serving import (
+            InferenceServer,
+            direct_predict,
+            load_artifact,
+            read_manifest,
+            register_artifact,
+        )
+
+        force_platform(
+            "cpu",
+            compile_cache_dir=os.path.join(_REPO, "tests", ".jax_cache"),
+        )
+        import numpy as np
+
+        man = read_manifest(export_dir)
+        if sorted(man.get("artifacts", {})) != ["0", "1"]:
+            failures.append(f"manifest lacks both artifacts: {man}")
+            print(json.dumps({"metric": "serve_smoke", "ok": False,
+                              "failures": failures}))
+            return 1
+
+        # Bit-identity per artifact x bucket: the exported program vs a
+        # freshly rebuilt (tracing) flax model over the same weights.
+        rng = np.random.RandomState(0)
+        for t in ("0", "1"):
+            apath = os.path.join(export_dir, man["artifacts"][t]["path"])
+            art = load_artifact(apath)
+            for bucket in art.buckets:
+                x = rng.randint(0, 256, (bucket, 32, 32, 3)).astype(np.uint8)
+                served = art.predict_padded(x, bucket)
+                direct = direct_predict(apath, x)
+                if not np.array_equal(served, direct):
+                    failures.append(
+                        f"task {t} bucket {bucket}: exported logits "
+                        "differ from the direct model call")
+
+        # Stage a serving dir holding only task 0, then serve under fire.
+        serve_dir = os.path.join(tmp, "serve")
+        os.makedirs(serve_dir)
+        shutil.copytree(os.path.join(export_dir, "task_000"),
+                        os.path.join(serve_dir, "task_000"))
+        register_artifact(serve_dir, 0, {"path": "task_000"})
+
+        serve_log = os.path.join(tmp, "serve.jsonl")
+        sink = JsonlLogger(serve_log)
+        inj = FaultInjector(
+            parse_fault_spec("swap_ioerror@task1"),
+            ledger_path=os.path.join(tmp, "fault_ledger.jsonl"),
+            sink=sink,
+        )
+        server = InferenceServer(
+            serve_dir, max_wait_ms=2.0, poll_s=0.1, sink=sink, faults=inj,
+        ).start()
+
+        results, errors = [], []
+        stop_traffic = threading.Event()
+
+        def traffic() -> None:
+            img = rng.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+            while not stop_traffic.is_set():
+                try:
+                    results.append(server.submit(img).result(timeout=60))
+                except Exception as e:  # noqa: BLE001 — recorded, asserted ==0
+                    errors.append(repr(e))
+
+        client = threading.Thread(target=traffic)
+        client.start()
+        try:
+            time.sleep(0.5)  # traffic against task 0 first
+            # Publish task 1 mid-traffic.  First poll trips swap_ioerror,
+            # second swaps cleanly.
+            shutil.copytree(os.path.join(export_dir, "task_001"),
+                            os.path.join(serve_dir, "task_001"))
+            register_artifact(serve_dir, 1, {"path": "task_001"})
+            deadline = time.time() + 30
+            while time.time() < deadline and server.task_id != 1:
+                time.sleep(0.1)
+            time.sleep(0.5)  # traffic against task 1 after the swap
+        finally:
+            stop_traffic.set()
+            client.join()
+            server.stop()
+
+        stats = server.stats()
+        if errors or stats["failed"]:
+            failures.append(
+                f"dropped/failed requests: errors={errors[:3]} "
+                f"failed={stats['failed']}")
+        task_ids = [r["task_id"] for r in results]
+        if not (task_ids and task_ids[0] == 0 and task_ids[-1] == 1
+                and sorted(set(task_ids)) == [0, 1]):
+            failures.append(
+                f"responses did not transition 0 -> 1: {sorted(set(task_ids))}")
+        if stats["swap_failures"] != 1:
+            failures.append(
+                f"expected exactly 1 failed swap, got {stats['swap_failures']}")
+        if server.trace_count() != 0:
+            failures.append(
+                f"serving hot path traced {server.trace_count()} program(s); "
+                "queries must only run AOT executables")
+
+        serve_recs = _records(serve_log)
+        kinds = [r.get("type") for r in serve_recs]
+        if "serve_swap_failed" not in kinds:
+            failures.append(f"no serve_swap_failed record: {kinds}")
+        swaps = [r for r in serve_recs if r.get("type") == "serve_swap"]
+        if [s.get("to_task") for s in swaps] != [0, 1]:
+            failures.append(f"serve_swap sequence wrong: {swaps}")
+
+        # Through-the-server bit-identity: a quiet server batches a lone
+        # request at bucket 1, so the response must equal the direct call.
+        server2 = InferenceServer(serve_dir, max_wait_ms=0.0, sink=sink).start()
+        try:
+            probe = rng.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+            res = server2.submit(probe).result(timeout=60)
+            direct = direct_predict(
+                os.path.join(serve_dir, "task_001"), probe[None]
+            )
+            if not (res["task_id"] == 1
+                    and np.array_equal(res["logits"], direct[0])):
+                failures.append(
+                    "server response logits differ from the direct model call")
+        finally:
+            server2.stop()
+
+        # Every telemetry stream the scenario produced must pass the lint.
+        lint = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "check_telemetry_schema.py"),
+             train_log, serve_log],
+            cwd=_REPO, timeout=120, capture_output=True, text=True)
+        if lint.returncode != 0:
+            failures.append(
+                f"schema lint failed on smoke telemetry: {lint.stdout.strip()} "
+                f"{lint.stderr.strip()}")
+
+        print(json.dumps({
+            "metric": "serve_smoke",
+            "ok": not failures,
+            "failures": failures,
+            "served": stats["served"],
+            "swaps": stats["swaps"],
+            "swap_failures": stats["swap_failures"],
+            "task_transition": sorted(set(task_ids)),
+            "trace_count": server.trace_count(),
+        }))
+        return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

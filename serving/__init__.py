@@ -1,0 +1,39 @@
+"""Serving: AOT-exported per-task artifacts + a hot-swapping batched server.
+
+The production story for class-incremental learning is a model that keeps
+*serving* while it keeps *learning*: WA (PAPER.md) grows a new head every
+task, but training alone cannot answer a query — everything the trainer
+computes dies when ``train.py`` exits.  This package is the inference half:
+
+* :mod:`.artifact` — after each task's weight alignment the trainer freezes
+  an inference-only pytree (params + batch stats + task metadata + class
+  map), AOT-lowers the predict function per supported batch bucket, and
+  serializes it with ``jax.export`` next to a sha256-sidecar'd weights
+  payload; a ``manifest.json`` names the newest task atomically.
+* :mod:`.server` — a stdlib-threaded micro-batching server over those
+  artifacts: pad-to-bucket dispatch with a max-wait deadline, and an atomic
+  hot swap when a new task's artifact lands in the manifest.
+* :mod:`.skew` — served-model accuracy re-measured through the artifact and
+  compared against the training-side accuracy matrix (``serve_skew``).
+
+Serving never traces: artifacts are loaded by AOT-compiling the deserialized
+exported programs, so a warm server restart (same artifacts, persistent XLA
+compilation cache) performs zero re-traces — provable with the same
+``RecompileSentinel`` contract the trainer uses (tests/test_serving.py).
+"""
+
+from .artifact import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    ServingArtifact,
+    direct_predict,
+    export_artifact,
+    export_from_trainer,
+    latest_artifact,
+    load_artifact,
+    make_predict_fn,
+    read_manifest,
+    rebuild_model,
+    register_artifact,
+)
+from .server import InferenceServer  # noqa: F401
+from .skew import measure_skew  # noqa: F401

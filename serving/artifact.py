@@ -1,0 +1,484 @@
+"""Per-task serving artifacts: freeze, AOT-export, verify, reload.
+
+One artifact = one directory ``<export_dir>/task_{t:03d}/`` holding
+
+* ``weights.pkl`` (+ ``.sha256`` sidecar) — host pytree of params + batch
+  stats + task metadata, written with the same atomic-rename + checksum
+  machinery the checkpoint layer uses (``utils/checkpoint.py``): payload tmp
+  → sidecar → ``os.replace``, so every crash window leaves either a complete
+  artifact or an orphan readers ignore.
+* ``exported_b{B:03d}.bin`` (+ sidecars) — the predict function serialized
+  with ``jax.export``, one per supported batch bucket.  Weights are
+  *arguments* of the exported program, not baked-in constants: the head is
+  statically full-width (``models/cil_model.py``), so the program is
+  byte-identical across tasks and every task after the first hits the
+  persistent XLA compilation cache (``utils/platform.py``) at both export
+  and load time.
+* ``meta.json`` — task id, active-class count, class map (head column →
+  original label), bucket list, and enough model/normalization description
+  to rebuild the live flax module for bit-identity parity checks
+  (:func:`rebuild_model`).
+
+``manifest.json`` at the export-dir root is the publication point: it is
+rewritten atomically (tmp + ``os.replace``) after the artifact directory is
+complete, so a server watching the manifest can never observe a half-written
+artifact.  Loading verifies every sidecar, then AOT-compiles each bucket's
+deserialized program via ``jit(...).lower(...).compile()`` — an AOT compile
+never populates a jit trace cache, which is what makes the server's
+zero-retrace contract enforceable (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from collections.abc import Mapping
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+    AugmentConfig,
+    eval_preprocess,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.checkpoint import (
+    _read_payload,
+    _sha256_file,
+    _write_pickle_atomic,
+    _write_sidecar,
+)
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 32, 64)
+
+_MANIFEST = "manifest.json"
+_WEIGHTS = "weights.pkl"
+_META = "meta.json"
+
+
+def _exported_name(bucket: int) -> str:
+    return f"exported_b{bucket:03d}.bin"
+
+
+def _plain(tree):
+    """Recursively rebuild mappings as plain dicts.
+
+    ``jax.export`` refuses pytrees containing unregistered container types
+    (flax ``FrozenDict``), and the weights pickle must have the *same* tree
+    structure the exported program was traced with — so both go through this
+    normalization.
+    """
+    if isinstance(tree, Mapping):
+        return {k: _plain(v) for k, v in tree.items()}
+    return tree
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), _plain(tree)
+    )
+
+
+def _specs_of(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype), tree
+    )
+
+
+def make_predict_fn(model, aug_cfg: AugmentConfig):
+    """The inference program: uint8 pixels in, full-width logits out.
+
+    Same computation as the trainer's eval step (``engine/train.py``):
+    normalize-only preprocessing, then the model in eval mode (BatchNorm
+    running statistics — every output row depends only on its input row,
+    which is what makes pad-to-bucket dispatch exact).  Weights ride as
+    arguments so the exported program is task-independent.
+    """
+
+    def predict(params, batch_stats, num_active, x_u8):
+        x = eval_preprocess(x_u8, aug_cfg)
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x,
+            num_active=num_active,
+            train=False,
+        )
+        return logits
+
+    return jax.jit(predict)
+
+
+def _write_bytes_atomic(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    _write_sidecar(path, tmp)
+    os.replace(tmp, path)
+
+
+# --------------------------------------------------------------------- #
+# Manifest
+# --------------------------------------------------------------------- #
+
+
+def read_manifest(export_dir: str) -> dict:
+    path = os.path.join(export_dir, _MANIFEST)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        # os.replace makes torn manifests near-impossible; treat a transient
+        # read failure as "nothing new" rather than crashing the watcher.
+        return {}
+
+
+def register_artifact(export_dir: str, task_id: int, entry: dict) -> None:
+    """Publish an artifact: read-modify-replace of ``manifest.json``.
+
+    The replace is the linearization point — a watcher sees either the old
+    manifest or the new one, never a mix.
+    """
+    man = read_manifest(export_dir)
+    man.setdefault("version", 1)
+    artifacts = man.setdefault("artifacts", {})
+    artifacts[str(task_id)] = entry
+    man["latest"] = max(int(t) for t in artifacts)
+    man["updated_ts"] = round(time.time(), 3)
+    path = os.path.join(export_dir, _MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def latest_artifact(export_dir: str) -> Optional[Tuple[int, str]]:
+    """``(task_id, artifact_dir)`` of the newest published artifact."""
+    man = read_manifest(export_dir)
+    latest = man.get("latest")
+    if latest is None:
+        return None
+    entry = man.get("artifacts", {}).get(str(latest))
+    if entry is None:
+        return None
+    return int(latest), os.path.join(export_dir, entry["path"])
+
+
+# --------------------------------------------------------------------- #
+# Export
+# --------------------------------------------------------------------- #
+
+
+def export_artifact(
+    export_dir: str,
+    task_id: int,
+    model,
+    aug_cfg: AugmentConfig,
+    params,
+    batch_stats,
+    known: int,
+    class_order: Sequence[int],
+    input_size: int,
+    channels: int,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    acc_per_task: Optional[Sequence[float]] = None,
+    model_meta: Optional[dict] = None,
+) -> str:
+    """Freeze + AOT-export one task's inference state; returns the artifact dir.
+
+    The directory is built under a ``.tmp`` name and renamed into place
+    before the manifest update, so the manifest only ever points at complete
+    artifacts.  Each bucket's program is additionally ``lower().compile()``d
+    here — partly validation (a program that cannot compile must fail the
+    export, not the first query), partly cache warming: the compile lands in
+    the persistent XLA cache the server's load will hit.
+    """
+    buckets = tuple(sorted({int(b) for b in buckets}))
+    if not buckets or buckets[0] <= 0:
+        raise ValueError(f"serve buckets must be positive ints, got {buckets!r}")
+    host_params = _host(params)
+    host_stats = _host(batch_stats)
+    final = os.path.join(export_dir, f"task_{task_id:03d}")
+    tmp_dir = final + ".tmp"
+    if os.path.exists(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+
+    _write_pickle_atomic(
+        os.path.join(tmp_dir, _WEIGHTS),
+        {
+            "task_id": task_id,
+            "known": int(known),
+            "params": host_params,
+            "batch_stats": host_stats,
+        },
+    )
+
+    predict = make_predict_fn(model, aug_cfg)
+    p_spec = _specs_of(host_params)
+    bs_spec = _specs_of(host_stats)
+    na_spec = jax.ShapeDtypeStruct((), jnp.int32)
+    exported_files: Dict[str, str] = {}
+    for bucket in buckets:
+        x_spec = jax.ShapeDtypeStruct(
+            (bucket, input_size, input_size, channels), jnp.uint8
+        )
+        exp = jax_export.export(predict)(p_spec, bs_spec, na_spec, x_spec)
+        predict.lower(p_spec, bs_spec, na_spec, x_spec).compile()
+        name = _exported_name(bucket)
+        _write_bytes_atomic(os.path.join(tmp_dir, name), exp.serialize())
+        exported_files[str(bucket)] = name
+
+    meta = {
+        "version": 1,
+        "task_id": int(task_id),
+        "known": int(known),
+        "class_map": [int(c) for c in list(class_order)[: int(known)]],
+        "buckets": list(buckets),
+        "input_size": int(input_size),
+        "channels": int(channels),
+        "mean": [float(m) for m in aug_cfg.mean],
+        "std": [float(s) for s in aug_cfg.std],
+        "model": dict(model_meta or {}),
+        "backend": jax.default_backend(),
+        "acc_per_task": (
+            [float(a) for a in acc_per_task] if acc_per_task is not None else None
+        ),
+        "files": {"weights": _WEIGHTS, "exported": exported_files},
+        "created_ts": round(time.time(), 3),
+    }
+    meta_tmp = os.path.join(tmp_dir, _META + ".tmp")
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    os.replace(meta_tmp, os.path.join(tmp_dir, _META))
+
+    if os.path.exists(final):
+        shutil.rmtree(final)  # re-export of the same task supersedes it
+    os.rename(tmp_dir, final)
+    register_artifact(
+        export_dir,
+        task_id,
+        {
+            "path": os.path.basename(final),
+            "known": int(known),
+            "buckets": list(buckets),
+            "updated_ts": round(time.time(), 3),
+        },
+    )
+    return final
+
+
+def export_from_trainer(trainer, task_id: int, known_after: int,
+                        acc_per_task=None) -> str:
+    """Trainer-side convenience: gather everything the export needs from a
+    live ``CilTrainer`` right after weight alignment."""
+    cfg = trainer.config
+    params = trainer.state.params
+    fc_bias = np.asarray(jax.device_get(params["fc_bias"]))
+    model_meta = {
+        "backbone": cfg.backbone,
+        "width": int(fc_bias.shape[0]),
+        "compute_dtype": cfg.compute_dtype,
+        "bn_group_size": int(cfg.bn_group_size),
+    }
+    return export_artifact(
+        cfg.export_dir,
+        task_id,
+        trainer.model,
+        trainer.aug_cfg,
+        params,
+        trainer.state.batch_stats,
+        known=known_after,
+        class_order=trainer.scenario_train.class_order,
+        input_size=cfg.input_size,
+        channels=trainer.channels,
+        buckets=cfg.serve_buckets,
+        acc_per_task=acc_per_task,
+        model_meta=model_meta,
+    )
+
+
+# --------------------------------------------------------------------- #
+# Load
+# --------------------------------------------------------------------- #
+
+
+class ServingArtifact:
+    """One loaded task artifact: verified weights + AOT-compiled programs.
+
+    ``predict``/``predict_padded`` only ever invoke the pre-compiled
+    executables — no jit dispatch, no tracing.  The per-bucket jit wrappers
+    are kept (never called) so a ``RecompileMonitor`` can watch their trace
+    caches stay at zero (:meth:`register_recompiles`).
+    """
+
+    def __init__(self, path: str, meta: dict, params, batch_stats,
+                 num_active, compiled: Dict[int, object],
+                 jit_fns: Dict[int, object], load_ms: float,
+                 compile_ms: float):
+        self.path = path
+        self.meta = meta
+        self.task_id = int(meta["task_id"])
+        self.known = int(meta["known"])
+        self.class_map = list(meta["class_map"])
+        self.buckets = tuple(sorted(compiled))
+        self.params = params
+        self.batch_stats = batch_stats
+        self.num_active = num_active
+        self.load_ms = load_ms
+        self.compile_ms = compile_ms
+        self._compiled = compiled
+        self._jit_fns = jit_fns
+
+    def bucket_for(self, n: int) -> Optional[int]:
+        for bucket in self.buckets:
+            if bucket >= n:
+                return bucket
+        return None
+
+    def predict_padded(self, x_u8: np.ndarray, bucket: int) -> np.ndarray:
+        """Full-bucket logits for a batch already padded to ``bucket`` rows."""
+        out = self._compiled[bucket](
+            self.params, self.batch_stats, self.num_active, jnp.asarray(x_u8)
+        )
+        return np.asarray(out)
+
+    def predict(self, x_u8: np.ndarray) -> np.ndarray:
+        """Logits for ``n`` images: pad to the smallest covering bucket (rows
+        are independent in eval mode, so padding never changes real rows),
+        chunk by the largest bucket when ``n`` exceeds it."""
+        x = np.ascontiguousarray(x_u8, dtype=np.uint8)
+        n = x.shape[0]
+        max_bucket = self.buckets[-1]
+        outs = []
+        for lo in range(0, n, max_bucket):
+            chunk = x[lo:lo + max_bucket]
+            m = chunk.shape[0]
+            bucket = self.bucket_for(m)
+            if m < bucket:
+                pad = np.zeros((bucket - m,) + chunk.shape[1:], np.uint8)
+                chunk = np.concatenate([chunk, pad])
+            outs.append(self.predict_padded(chunk, bucket)[:m])
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def register_recompiles(self, monitor, group: str = "serve") -> None:
+        """Track the (never-called) jit wrappers: ``monitor.total(group)``
+        staying at 0 is the proof that serving never traced."""
+        for bucket, fn in sorted(self._jit_fns.items()):
+            monitor.track(f"serve_b{bucket}[task{self.task_id}]", fn, group=group)
+
+
+def load_artifact(path: str) -> ServingArtifact:
+    """Verify and load one artifact directory; AOT-compile every bucket.
+
+    Raises ``OSError`` on any integrity failure (missing/corrupt weights or
+    exported blob) — the server treats that as a failed swap and keeps
+    serving its current artifact.
+    """
+    t0 = time.perf_counter()
+    meta_path = os.path.join(path, _META)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise OSError(f"unreadable artifact meta {meta_path}: {e!r}")
+    payload, why = _read_payload(os.path.join(path, meta["files"]["weights"]))
+    if payload is None:
+        raise OSError(f"invalid artifact weights in {path}: {why}")
+    params = jax.device_put(payload["params"])
+    batch_stats = jax.device_put(payload["batch_stats"])
+    num_active = jnp.asarray(meta["known"], jnp.int32)
+    p_spec = _specs_of(payload["params"])
+    bs_spec = _specs_of(payload["batch_stats"])
+    na_spec = jax.ShapeDtypeStruct((), jnp.int32)
+
+    compiled: Dict[int, object] = {}
+    jit_fns: Dict[int, object] = {}
+    t_compile = 0.0
+    for bucket_s, name in sorted(
+        meta["files"]["exported"].items(), key=lambda kv: int(kv[0])
+    ):
+        bucket = int(bucket_s)
+        blob_path = os.path.join(path, name)
+        sidecar = blob_path + ".sha256"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                want = f.read().strip()
+            got = _sha256_file(blob_path)
+            if got != want:
+                raise OSError(
+                    f"checksum mismatch for {blob_path} "
+                    f"(want {want[:12]}, got {got[:12]})"
+                )
+        with open(blob_path, "rb") as f:
+            exp = jax_export.deserialize(bytearray(f.read()))
+        fn = jax.jit(exp.call)
+        x_spec = jax.ShapeDtypeStruct(
+            (bucket, meta["input_size"], meta["input_size"], meta["channels"]),
+            jnp.uint8,
+        )
+        tc = time.perf_counter()
+        compiled[bucket] = fn.lower(p_spec, bs_spec, na_spec, x_spec).compile()
+        t_compile += time.perf_counter() - tc
+        jit_fns[bucket] = fn
+    return ServingArtifact(
+        path, meta, params, batch_stats, num_active, compiled, jit_fns,
+        load_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+        compile_ms=round(t_compile * 1000.0, 3),
+    )
+
+
+# --------------------------------------------------------------------- #
+# Parity: rebuild the live model from an artifact (tests / smoke only)
+# --------------------------------------------------------------------- #
+
+
+def rebuild_model(meta: dict):
+    """Fresh flax module + eval AugmentConfig equivalent to the exported
+    program — the 'direct model call' side of the bit-identity checks."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+    )
+
+    mm = meta["model"]
+    dtype = jnp.bfloat16 if mm.get("compute_dtype") == "bfloat16" else jnp.float32
+    model, _ = create_model(
+        mm["backbone"],
+        mm["width"],
+        dtype=dtype,
+        width_multiple=1,
+        input_size=meta["input_size"],
+        channels=meta["channels"],
+        bn_group_size=mm.get("bn_group_size", 0),
+    )
+    aug_cfg = AugmentConfig(
+        input_size=meta["input_size"],
+        mean=tuple(meta["mean"]),
+        std=tuple(meta["std"]),
+    )
+    return model, aug_cfg
+
+
+def direct_predict(path: str, x_u8: np.ndarray) -> np.ndarray:
+    """Logits from a freshly rebuilt (non-exported) model over the artifact's
+    weights, at exactly the given batch shape.  This call *traces* — it is
+    the reference side of the parity check, never part of the serving path."""
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    payload, why = _read_payload(os.path.join(path, meta["files"]["weights"]))
+    if payload is None:
+        raise OSError(f"invalid artifact weights in {path}: {why}")
+    model, aug_cfg = rebuild_model(meta)
+    predict = make_predict_fn(model, aug_cfg)
+    out = predict(
+        payload["params"],
+        payload["batch_stats"],
+        jnp.asarray(meta["known"], jnp.int32),
+        jnp.asarray(np.ascontiguousarray(x_u8, np.uint8)),
+    )
+    return np.asarray(out)

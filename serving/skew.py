@@ -1,0 +1,84 @@
+"""Training/serving skew: re-measure accuracy *through the artifact*.
+
+The trainer's accuracy matrix says what the live model scored right after
+weight alignment; this module asks the question production actually cares
+about — does the *served* model (export → serialize → reload → AOT program)
+still score that?  Any gap (a stale artifact after a failed swap, a
+normalization mismatch between the exported preprocessing and training
+eval, a corrupted weights payload that still unpickles) shows up as skew.
+
+``measure_skew`` evaluates every seen task's validation slice through
+``ServingArtifact.predict`` and emits one ``serve_skew`` record comparing
+the per-task served accuracies with the training-side row (the ``task``
+record's ``acc_per_task``).  For a healthy artifact the skew is exactly
+zero: the exported program is the same computation as the trainer's eval
+step at the same batch shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.datasets import (
+    maybe_decode,
+)
+
+
+def _slice_accuracy(artifact, x: np.ndarray, y: np.ndarray) -> float:
+    logits = artifact.predict(x)
+    top1 = np.argmax(logits[:, : artifact.known], axis=-1)
+    return float(100.0 * np.mean(top1 == np.asarray(y)))
+
+
+def measure_skew(
+    artifact,
+    scenario_val,
+    sink=None,
+    train_acc_per_task: Optional[Sequence[float]] = None,
+    seed: int = 0,
+) -> dict:
+    """Per-seen-task served accuracy vs the training row; one record.
+
+    ``scenario_val`` is the validation ``ClassIncremental`` scenario; the
+    artifact's ``known`` determines how many of its tasks the served head
+    covers.  Returns the record fields (also logged to ``sink`` when given).
+    """
+    increments = scenario_val.increments()
+    seen, cum = 0, 0
+    for inc in increments:
+        if cum + inc > artifact.known:
+            break
+        cum += inc
+        seen += 1
+    served, weights = [], []
+    for j in range(seen):
+        task = scenario_val[j]
+        x = maybe_decode(task.x, artifact.meta["input_size"], train=False,
+                         seed=seed)
+        served.append(round(_slice_accuracy(artifact, x, task.y), 5))
+        weights.append(len(task.y))
+    total = max(sum(weights), 1)
+    served_acc1 = round(
+        float(sum(a * w for a, w in zip(served, weights)) / total), 5
+    )
+    train_row = (
+        [float(a) for a in train_acc_per_task[:seen]]
+        if train_acc_per_task is not None else None
+    )
+    skew_abs_max = (
+        round(max(abs(s - t) for s, t in zip(served, train_row)), 5)
+        if train_row else None
+    )
+    record = dict(
+        task_id=artifact.task_id,
+        served_acc1=served_acc1,
+        served_acc_per_task=served,
+        train_acc_per_task=train_row,
+        skew_abs_max=skew_abs_max,
+        n=int(total),
+    )
+    if sink is not None:
+        sink.log("serve_skew", **record)
+    return record

@@ -100,6 +100,58 @@ def test_resume_without_checkpoint_is_fresh(devices8, tmp_path):
     assert t.start_task == 0 and t.known == 0
 
 
+def test_epoch_checkpoint_orbax_round_trip(devices8, tmp_path):
+    """Epoch checkpoints honour --ckpt_backend orbax: the crash run leaves a
+    ``task_*_epoch_*.orbax`` directory + checksummed ``.meta`` sidecar, the
+    resume is epoch-granular through the orbax restore path (momentum and
+    teacher included), and the finished run is bit-identical to the
+    fault-free twin — the same contract the pickle epoch path proves in
+    tests/test_faults.py."""
+    from faults.injector import FaultInjected
+
+    mesh = make_mesh((8, 1))
+    ckpt = str(tmp_path / "ckpts")
+    spec = "raise@task1.epoch1"
+
+    twin = CilTrainer(_cfg(), mesh=mesh, init_dist=False)
+    ref = twin.fit()
+
+    crashed = CilTrainer(
+        _cfg(ckpt_dir=ckpt, ckpt_backend="orbax", epoch_ckpt_every=1,
+             fault_spec=spec),
+        mesh=mesh, init_dist=False,
+    )
+    with pytest.raises(FaultInjected):
+        crashed.fit()
+    names = os.listdir(ckpt)
+    assert "task_001_epoch_001.orbax" in names
+    assert "task_001_epoch_001.orbax.meta" in names
+    assert "task_001_epoch_001.orbax.meta.sha256" in names
+
+    resumed = CilTrainer(
+        _cfg(ckpt_dir=ckpt, ckpt_backend="orbax", epoch_ckpt_every=1,
+             fault_spec=spec, resume=True),
+        mesh=mesh, init_dist=False,
+    )
+    assert resumed.start_task == 1
+    assert resumed.start_epoch == 1
+    assert resumed.resumed_from["kind"] == "epoch"
+    assert resumed.resumed_from["path"].endswith("task_001_epoch_001.orbax")
+    assert resumed.teacher is not None  # restored from the orbax tree
+    out = resumed.fit()
+
+    assert out["acc1s"] == ref["acc1s"]
+    assert out["acc_matrix"] == ref["acc_matrix"]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(twin.state.params),
+        jax.tree_util.tree_leaves(resumed.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Task 1's boundary checkpoint promoted the epoch scratch away — orbax
+    # directory, .meta sidecar and checksum all gone.
+    assert not any("_epoch_" in n for n in os.listdir(ckpt))
+
+
 def test_incomplete_orbax_checkpoint_ignored(tmp_path):
     """An orbax dir without its metadata sidecar is not a resumable
     checkpoint (crash window between the two writes), and a torn/corrupt

@@ -1,0 +1,275 @@
+"""Serving artifacts + hot-swapping server (ISSUE 8 acceptance contracts).
+
+The library-level proofs that back ``scripts/serve_smoke.py``:
+
+* an exported artifact reproduces the live model bit-for-bit at every
+  bucket, survives a round trip through a *fresh process*, and pads to a
+  bucket without perturbing real rows;
+* a warm server restart over existing artifacts performs **zero** traces —
+  pinned with the same ``RecompileSentinel`` budget contract the trainer
+  uses (budget 0: no growth/restore events are granted to serving);
+* a failed hot swap degrades gracefully under live traffic: no request is
+  dropped, ``serve_swap_failed`` is emitted, and the retry swaps cleanly.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+    AugmentConfig,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+    create_model,
+    grow,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+    RecompileMonitor,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (
+    JsonlLogger,
+)
+from analysis.runtime import RecompileBudgetExceeded, RecompileSentinel
+from faults.injector import FaultInjector, parse_fault_spec
+from serving import (
+    InferenceServer,
+    direct_predict,
+    latest_artifact,
+    load_artifact,
+    read_manifest,
+    register_artifact,
+    export_artifact,
+)
+
+pytestmark = pytest.mark.heavy  # e2e tier: exports AOT-compile real programs
+
+BUCKETS = (1, 4)
+NB = 10
+
+
+def _export_task(export_dir, task_id, known, seed):
+    model, variables = create_model("resnet20", NB)
+    variables = grow(variables, jax.random.PRNGKey(seed), 0, known)
+    return export_artifact(
+        export_dir, task_id, model, AugmentConfig(),
+        variables["params"], variables["batch_stats"],
+        known=known, class_order=list(range(NB)),
+        input_size=32, channels=3, buckets=BUCKETS,
+        model_meta={"backbone": "resnet20", "width": NB,
+                    "compute_dtype": "float32", "bn_group_size": 0},
+    )
+
+
+@pytest.fixture(scope="module")
+def export_dir(tmp_path_factory):
+    """Two task artifacts (known=5, then 10) over the full-width head."""
+    d = str(tmp_path_factory.mktemp("serve") / "export")
+    os.makedirs(d)
+    _export_task(d, 0, known=5, seed=0)
+    _export_task(d, 1, known=NB, seed=1)
+    return d
+
+
+def _img(rng, n=None):
+    shape = (32, 32, 3) if n is None else (n, 32, 32, 3)
+    return rng.randint(0, 256, shape).astype(np.uint8)
+
+
+def test_manifest_registry(export_dir):
+    man = read_manifest(export_dir)
+    assert sorted(man["artifacts"]) == ["0", "1"]
+    assert man["latest"] == 1
+    task_id, path = latest_artifact(export_dir)
+    assert task_id == 1 and path.endswith("task_001")
+    # Registration is idempotent on re-export and monotone on `latest`.
+    register_artifact(export_dir, 0, {"path": "task_000"})
+    assert read_manifest(export_dir)["latest"] == 1
+
+
+def test_bit_identity_per_bucket(export_dir):
+    """Every bucket's AOT program == the live (tracing) flax model, bitwise,
+    for both tasks — the exported computation is the *same* computation."""
+    rng = np.random.RandomState(0)
+    man = read_manifest(export_dir)
+    for t in ("0", "1"):
+        apath = os.path.join(export_dir, man["artifacts"][t]["path"])
+        art = load_artifact(apath)
+        assert art.buckets == BUCKETS
+        for bucket in art.buckets:
+            x = _img(rng, bucket)
+            np.testing.assert_array_equal(
+                art.predict_padded(x, bucket), direct_predict(apath, x)
+            )
+        # Full-width head, masked beyond `known`: a frozen task-0 artifact
+        # can never argmax to a class it had not seen.
+        out = art.predict_padded(_img(rng, art.buckets[0]), art.buckets[0])
+        assert out.shape[-1] == NB
+        assert np.all(np.argmax(out, axis=-1) < art.known)
+        assert np.all(out[:, art.known:] <= -1e9)
+
+
+def test_pad_to_bucket_identity(export_dir):
+    """predict() pads ragged batches to the covering bucket; row independence
+    of eval-mode BN makes the real rows bit-identical to the padded call."""
+    rng = np.random.RandomState(1)
+    _, apath = latest_artifact(export_dir)
+    art = load_artifact(apath)
+    x3 = _img(rng, 3)  # 3 -> bucket 4
+    padded = np.concatenate([x3, np.zeros((1, 32, 32, 3), np.uint8)])
+    np.testing.assert_array_equal(
+        art.predict(x3), art.predict_padded(padded, 4)[:3]
+    )
+    assert art.bucket_for(3) == 4
+    assert art.bucket_for(5) is None  # beyond the largest bucket
+    # Chunking: n > max bucket splits by the largest bucket, same rows.
+    x6 = _img(rng, 6)
+    out = art.predict(x6)
+    assert out.shape == (6, NB)
+    np.testing.assert_array_equal(out[:4], art.predict_padded(x6[:4], 4))
+
+
+def test_fresh_process_reload_bit_identity(export_dir, tmp_path):
+    """The on-disk artifact is self-contained: a brand-new Python process
+    (no shared jit caches, no live model) reproduces this process's logits
+    bit-for-bit from the serialized program + checksummed weights."""
+    rng = np.random.RandomState(2)
+    _, apath = latest_artifact(export_dir)
+    x = _img(rng, BUCKETS[-1])
+    here = load_artifact(apath).predict_padded(x, BUCKETS[-1])
+
+    x_npy = str(tmp_path / "x.npy")
+    out_npy = str(tmp_path / "out.npy")
+    np.save(x_npy, x)
+    prog = (
+        "import sys, numpy as np\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"jax.config.update('jax_compilation_cache_dir', {os.path.join(os.path.dirname(os.path.abspath(__file__)), '.jax_cache')!r})\n"
+        "from serving import load_artifact\n"
+        f"art = load_artifact({apath!r})\n"
+        f"x = np.load({x_npy!r})\n"
+        f"np.save({out_npy!r}, art.predict_padded(x, {BUCKETS[-1]}))\n"
+    )
+    subprocess.run([sys.executable, "-c", prog], check=True, timeout=600)
+    np.testing.assert_array_equal(here, np.load(out_npy))
+
+
+def test_corrupt_weights_refused(export_dir, tmp_path):
+    """A flipped byte in the weights payload fails the sha256 check at load
+    — a server swap to it degrades instead of serving garbage."""
+    _, apath = latest_artifact(export_dir)
+    bad = str(tmp_path / "task_bad")
+    shutil.copytree(apath, bad)
+    wpath = os.path.join(bad, "weights.pkl")
+    blob = bytearray(open(wpath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(wpath, "wb") as f:
+        f.write(blob)
+    with pytest.raises(OSError):
+        load_artifact(bad)
+
+
+def test_warm_restart_zero_traces(export_dir):
+    """Two consecutive servers over the same artifacts: neither traces a
+    single program (queries only run AOT executables), pinned by a
+    RecompileSentinel with budget 0 — serving grants *no* compile events."""
+    rng = np.random.RandomState(3)
+    for restart in range(2):
+        monitor = RecompileMonitor()
+        sentinel = RecompileSentinel(monitor, group="serve", enforce=True)
+        server = InferenceServer(
+            export_dir, max_wait_ms=0.0, monitor=monitor
+        ).start()
+        try:
+            for f in [server.submit(_img(rng)) for _ in range(6)]:
+                res = f.result(timeout=60)
+                assert res["task_id"] == 1
+                assert res["latency_ms"] >= 0.0
+            stats = server.stats()
+            assert stats["served"] == 6 and stats["failed"] == 0
+            assert stats["p99_ms"] >= stats["p50_ms"] >= 0.0
+            assert server.trace_count() == 0
+            # budget == 0 events * 1 -> any traced program would raise here.
+            assert sentinel.check(f"warm-restart-{restart}") == 0
+        finally:
+            server.stop()
+    # The sentinel is live, not vacuous: a tracked jit that *does* trace
+    # busts the zero budget.
+    canary = jax.jit(lambda v: v + 1)
+    monitor.track("canary", canary, group="serve")
+    canary(np.float32(1.0))
+    with pytest.raises(RecompileBudgetExceeded):
+        sentinel.check("canary")
+
+
+def test_hot_swap_failure_degrades_gracefully(export_dir, tmp_path):
+    """swap_ioerror on the first attempt: the server keeps serving task 0,
+    emits serve_swap_failed, drops nothing, and the one-shot clause lets the
+    next poll swap cleanly to task 1 under continuing traffic."""
+    rng = np.random.RandomState(4)
+    serve_dir = str(tmp_path / "serve")
+    os.makedirs(serve_dir)
+    shutil.copytree(os.path.join(export_dir, "task_000"),
+                    os.path.join(serve_dir, "task_000"))
+    register_artifact(serve_dir, 0, {"path": "task_000"})
+
+    log = str(tmp_path / "serve.jsonl")
+    sink = JsonlLogger(log)
+    inj = FaultInjector(
+        parse_fault_spec("swap_ioerror@task1"),
+        ledger_path=str(tmp_path / "ledger.jsonl"), sink=sink,
+    )
+    server = InferenceServer(
+        serve_dir, max_wait_ms=1.0, poll_s=0.05, sink=sink, faults=inj
+    ).start()
+
+    results, errors = [], []
+    stop = threading.Event()
+
+    def traffic():
+        img = _img(rng)
+        while not stop.is_set():
+            try:
+                results.append(server.submit(img).result(timeout=60))
+            except Exception as e:  # noqa: BLE001 — asserted empty below
+                errors.append(repr(e))
+
+    client = threading.Thread(target=traffic)
+    client.start()
+    try:
+        time.sleep(0.2)
+        shutil.copytree(os.path.join(export_dir, "task_001"),
+                        os.path.join(serve_dir, "task_001"))
+        register_artifact(serve_dir, 1, {"path": "task_001"})
+        deadline = time.time() + 60
+        while time.time() < deadline and server.task_id != 1:
+            time.sleep(0.05)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        client.join()
+        server.stop()
+
+    stats = server.stats()
+    assert not errors and stats["failed"] == 0
+    task_ids = [r["task_id"] for r in results]
+    assert task_ids[0] == 0 and task_ids[-1] == 1
+    assert sorted(set(task_ids)) == [0, 1]
+    assert stats["swaps"] == 1 and stats["swap_failures"] == 1
+    assert server.trace_count() == 0
+
+    kinds = [json.loads(ln)["type"] for ln in open(log) if ln.strip()]
+    assert "serve_swap_failed" in kinds
+    swaps = [json.loads(ln) for ln in open(log)
+             if ln.strip() and json.loads(ln)["type"] == "serve_swap"]
+    assert [s["to_task"] for s in swaps] == [0, 1]
+    assert swaps[0]["from_task"] is None and swaps[1]["from_task"] == 0
