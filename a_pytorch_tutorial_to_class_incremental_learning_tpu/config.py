@@ -165,6 +165,10 @@ class CilConfig:
     # device state shares no buffers with the host payload (the PR 3
     # zero-copy aliasing SIGBUS), then poison the dead host copies so any
     # missed alias fails as NaNs immediately
+    check_threads: bool = False  # ThreadCheck sentinel: wrap this repo's
+    # threading.Lock/RLock to detect lock-order inversions and lock-held
+    # blocking calls at runtime; each emits a thread_violation record
+    # (analysis/threadcheck.py; the chaos/serve smokes fail on any)
 
     # Profiling (SURVEY.md §5: absent in the reference; near-free here)
     profile_dir: Optional[str] = None  # trace each task's first epoch
@@ -314,6 +318,11 @@ def get_args_parser() -> argparse.ArgumentParser:
                    "arrays share no buffers with the host payload and poison "
                    "the dead host copies (turns silent zero-copy aliasing "
                    "into a deterministic failure)")
+    p.add_argument("--check_threads", action="store_true", default=False,
+                   help="install the ThreadCheck sentinel: record per-thread "
+                   "held-lock sets and global acquisition order, emit a "
+                   "thread_violation record on any lock-order inversion or "
+                   "lock-held blocking call (analysis/threadcheck.py)")
     p.add_argument("--profile_dir", default=None, type=str,
                    help="write a jax.profiler trace of each task's first epoch")
     p.add_argument("--log_file", default=None, type=str,
@@ -441,6 +450,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         fault_state=args.fault_state,
         recompile_budget=args.recompile_budget,
         check_donation=args.check_donation,
+        check_threads=args.check_threads,
         profile_dir=args.profile_dir,
         log_file=args.log_file,
         telemetry_dir=args.telemetry_dir,

@@ -92,6 +92,15 @@ class CilTrainer:
         # the very first phase (scenario build) is already witnessed.  With a
         # telemetry dir but no explicit --log_file the run records default to
         # <telemetry_dir>/run.jsonl — one stream carries the whole run.
+        # Opt-in runtime contract (--check_threads): install before the
+        # telemetry stack so its locks (heartbeat, flight recorder,
+        # prefetch) are created instrumented; the sink is bound below once
+        # the run log exists (violations seen in between are buffered).
+        self.threadcheck = None
+        if config.check_threads:
+            from analysis import threadcheck
+
+            self.threadcheck = threadcheck.install()
         log_path = config.log_file
         if log_path is None and config.telemetry_dir:
             log_path = os.path.join(config.telemetry_dir, "run.jsonl")
@@ -108,6 +117,8 @@ class CilTrainer:
         # FlightSink tee; rebind so every engine record (epoch/task/fault)
         # also lands in the crash-forensics ring.
         self.jsonl = self.telemetry.sink
+        if self.threadcheck is not None:
+            self.threadcheck.bind_sink(self.jsonl)
         # Deterministic fault injection (--fault_spec; faults/injector.py).
         # None when unset, so every hot-path site pays one identity check.
         # The ledger defaults next to the checkpoints: a supervised relaunch

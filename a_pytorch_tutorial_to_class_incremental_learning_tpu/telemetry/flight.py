@@ -72,8 +72,15 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=self.capacity)
         self._open_spans: List[dict] = []
         self._lock = threading.Lock()
+        # Serializes the publish step (freeze re-check + os.replace) so a
+        # periodic dump that snapshotted *before* a fatal dump can never
+        # overwrite the forensic file *after* it.  Acquisition order is
+        # always _io_lock -> _lock, never the reverse (jaxlint JL303); the
+        # slow tmp-file write happens under neither (JL304).
+        self._io_lock = threading.Lock()
         self._seq = 0          # total events ever recorded (dropped = seq - len)
         self._fatal = False    # a fatal dump already captured the death state
+        #                        (guarded by _lock; jaxlint JL305)
         self._installed = False
         self._prev_excepthook = None
         self._prev_sigterm = None
@@ -119,19 +126,23 @@ class FlightRecorder:
         death).  A no-op once a fatal dump captured the death state: the
         heartbeat daemon keeps running for a few ms after an injected kill's
         dump, and its cadence dump must not overwrite the forensic tail."""
-        if self._fatal:
-            return None
-        return self._write_dump(reason)
+        return self._write_dump(reason, fatal=False)
 
     def fatal_dump(self, reason: str = "fatal") -> Optional[dict]:
         """Death-path dump (injected kill, SIGTERM, unhandled exception):
         freezes the on-disk tail — later periodic/atexit dumps are skipped so
         the post-mortem artifact is the state *at death*."""
-        self._fatal = True
-        return self._write_dump(reason)
+        return self._write_dump(reason, fatal=True)
 
-    def _write_dump(self, reason: str) -> Optional[dict]:
+    def _write_dump(self, reason: str, fatal: bool = False) -> Optional[dict]:
         with self._lock:
+            # The freeze gate and flag live under the lock: dump() runs on
+            # the heartbeat daemon while fatal_dump() runs on whichever
+            # thread is dying (jaxlint JL305 flagged the bare flag).
+            if self._fatal and not fatal:
+                return None
+            if fatal:
+                self._fatal = True
             events = list(self._events)
             open_spans = [dict(s) for s in self._open_spans]
             seq = self._seq
@@ -159,7 +170,17 @@ class FlightRecorder:
                 json.dump(payload, f)
                 f.flush()
                 os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+            # Atomic publish: re-check the freeze under _io_lock so the
+            # ordering "fatal dump replaced the file" -> "every later
+            # periodic replace is suppressed" is airtight even when this
+            # dump snapshotted before the fatal one landed.
+            with self._io_lock:
+                with self._lock:
+                    frozen = self._fatal and not fatal
+                if frozen:
+                    os.unlink(tmp)
+                    return None
+                os.replace(tmp, self.path)
         except OSError:
             try:
                 os.unlink(tmp)
@@ -201,8 +222,7 @@ class FlightRecorder:
         atexit.register(self._atexit_dump)
 
     def _atexit_dump(self) -> None:
-        if not self._fatal:
-            self.dump("atexit")
+        self.dump("atexit")  # the freeze gate in _write_dump handles fatal
 
     def uninstall(self) -> None:
         """Undo :meth:`install` (facade close; also keeps tests that build

@@ -86,10 +86,13 @@ class Heartbeat:
         """Record the loop's latest position; write if the cadence is due."""
         if not self.enabled:
             return
+        now = time.monotonic()
         with self._lock:
             self._state.update({k: v for k, v in state.items() if v is not None})
-        now = time.monotonic()
-        if force or now - self._last_write >= self.interval_s:
+            # _last_write is written by the daemon thread under the lock;
+            # reading it outside raced the cadence decision (jaxlint JL305).
+            due = force or now - self._last_write >= self.interval_s
+        if due:
             self._write()
 
     def start(self) -> None:

@@ -29,6 +29,9 @@ Rule catalog (see README "Static analysis"):
   exception, nor reports it (log/print/warn) — on the training hot paths a
   silently eaten error turns a crash the supervisor could recover from into
   a wrong-numbers run nobody notices.
+* JL303–JL306 — interprocedural lock discipline (threadlint): lock-order
+  inversion, blocking under a lock, inconsistent locksets, torn thread-side
+  file writes.  Implemented in :mod:`analysis.threads`.
 
 The donation pass is a light abstract interpreter: it tracks which local
 names/attributes are bound to donating callables (including builder
@@ -47,6 +50,7 @@ import ast
 from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 from .findings import Finding
+from .threads import ThreadIndex, run_thread_rules
 
 RULES: Dict[str, str] = {
     "JL000": "file does not parse",
@@ -58,6 +62,10 @@ RULES: Dict[str, str] = {
     "JL201": "host sync inside a device hot loop",
     "JL301": "attribute written by producer thread and consumer outside the lock",
     "JL302": "over-broad except handler silently swallows the error",
+    "JL303": "lock-order inversion: the acquisition-order graph has a cycle",
+    "JL304": "blocking call (result/get/join/wait/file I/O) while holding a lock",
+    "JL305": "attribute accessed under inconsistent locksets across methods",
+    "JL306": "thread-side truncate-write without the atomic tmp-rename idiom",
 }
 
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
@@ -133,11 +141,13 @@ class ProjectIndex:
     def __init__(self) -> None:
         self.builders: Dict[str, FrozenSet[int]] = {}
         self.donating_attrs: Dict[str, Tuple[str, FrozenSet[int]]] = {}
+        self.threads: ThreadIndex = ThreadIndex()
 
     @classmethod
     def build(cls, modules: Iterable[Tuple[str, ast.Module]]) -> "ProjectIndex":
         idx = cls()
         mods = list(modules)
+        idx.threads = ThreadIndex.build(mods)
         for _, tree in mods:
             for node in ast.walk(tree):
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -1059,4 +1069,5 @@ def run_rules(path: str, tree: ast.Module, index: ProjectIndex) -> List[Finding]
     run_host_sync(path, tree, out)
     run_thread_shared(path, tree, out)
     run_swallowed_errors(path, tree, out)
+    run_thread_rules(path, tree, index.threads, out)
     return out

@@ -1,0 +1,316 @@
+"""threadcheck: runtime cooperative race/deadlock sentinel (``--check_threads``).
+
+The dynamic half of threadlint (:mod:`analysis.threads`).  ``install()``
+monkeypatches ``threading.Lock``/``threading.RLock`` so every lock *created
+by this repo's code* is wrapped in a recorder that tracks, per thread, the
+ordered set of held locks and, globally, the acquisition-order graph.  Locks
+created by the stdlib or third-party packages (``queue.Queue`` internals,
+jax's caches) are left raw — the sentinel checks *our* lock discipline, not
+CPython's.  Lock identity is the creation site (``file:line``), matching the
+per-class identity the static analysis uses.
+
+Detected at runtime, each emitted as a schema-checked ``thread_violation``
+telemetry record (and kept in ``violations`` for asserts):
+
+* ``lock_order_inversion`` — acquiring ``B`` while holding ``A`` after the
+  opposite order was observed anywhere earlier in the process (the classic
+  ABBA deadlock, caught even when the timing never actually deadlocks);
+* ``lock_held_blocking`` — a blocking ``queue.Queue.get(block=True)``,
+  ``concurrent.futures.Future.result`` or ``threading.Thread.join`` while
+  holding any instrumented lock.  (File I/O under a lock is left to the
+  static JL304 — patching ``open`` would tax every import in the process.)
+
+Cooperative and near-free: no tracing hooks, just a list append per
+acquire.  ``CilTrainer`` installs it before any telemetry lock exists when
+``--check_threads`` is set and binds the run's JSONL sink once it is up;
+the chaos and serve smokes run under it and fail on any record.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+# Captured before any patching: the sentinel's own mutex and the raw inner
+# locks it hands out must never be instrumented.
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+_THIS_FILE = os.path.abspath(__file__)
+_DEFAULT_SCOPE = os.path.dirname(os.path.dirname(_THIS_FILE))
+
+_ACTIVE: Optional["ThreadCheck"] = None
+
+
+class _CheckedLock:
+    """Delegating wrapper around a raw ``Lock``/``RLock`` that reports every
+    acquire/release to the active :class:`ThreadCheck`."""
+
+    __slots__ = ("_inner", "_check", "name", "reentrant")
+
+    def __init__(self, inner, check: "ThreadCheck", name: str,
+                 reentrant: bool) -> None:
+        self._inner = inner
+        self._check = check
+        self.name = name
+        self.reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._check._on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._check._on_released(self)
+        self._inner.release()
+
+    def __enter__(self) -> "_CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        fn = getattr(self._inner, "locked", None)
+        return fn() if fn is not None else bool(
+            self._inner._is_owned())  # RLock pre-3.12 has no .locked()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<_CheckedLock {self.name}>"
+
+
+class ThreadCheck:
+    """Per-thread held-lock sets + a global acquisition-order graph.
+
+    Use the module-level :func:`install`/:func:`uninstall` (process-global,
+    idempotent) rather than instantiating directly; tests that need a fresh
+    graph install, assert on ``violations``, and uninstall in ``finally``.
+    """
+
+    def __init__(self, sink=None, scope_root: Optional[str] = None) -> None:
+        self.scope_root = os.path.abspath(scope_root or _DEFAULT_SCOPE)
+        self.violations: List[dict] = []
+        self._tls = threading.local()
+        self._meta_lock = _RAW_LOCK()
+        self._sink = sink
+        self._buffered: List[dict] = []
+        # (held_name, acquired_name) -> site where the edge was first seen
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._reported: Set[frozenset] = set()
+        self._originals: dict = {}
+        self._installed = False
+
+    # ------------------------------------------------------------------ #
+    # Installation
+    # ------------------------------------------------------------------ #
+
+    def _install(self) -> None:
+        if self._installed:
+            return
+        self._installed = True
+        import queue as queue_mod
+        from concurrent.futures import Future
+
+        self._originals = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+            "Queue.get": queue_mod.Queue.get,
+            "Future.result": Future.result,
+            "Thread.join": threading.Thread.join,
+        }
+        threading.Lock = self._factory(_RAW_LOCK, reentrant=False)
+        threading.RLock = self._factory(_RAW_RLOCK, reentrant=True)
+
+        check = self
+        raw_get = self._originals["Queue.get"]
+        raw_result = self._originals["Future.result"]
+        raw_join = self._originals["Thread.join"]
+
+        def get(q, block=True, timeout=None):
+            if block:
+                check._on_blocking("queue.Queue.get")
+            return raw_get(q, block, timeout)
+
+        def result(fut, timeout=None):
+            check._on_blocking("concurrent.futures.Future.result")
+            return raw_result(fut, timeout)
+
+        def join(thread, timeout=None):
+            check._on_blocking("threading.Thread.join")
+            return raw_join(thread, timeout)
+
+        queue_mod.Queue.get = get
+        Future.result = result
+        threading.Thread.join = join
+
+    def _uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        import queue as queue_mod
+        from concurrent.futures import Future
+
+        threading.Lock = self._originals["Lock"]
+        threading.RLock = self._originals["RLock"]
+        queue_mod.Queue.get = self._originals["Queue.get"]
+        Future.result = self._originals["Future.result"]
+        threading.Thread.join = self._originals["Thread.join"]
+
+    def _factory(self, raw, reentrant: bool):
+        def make_lock():
+            inner = raw()
+            frame = sys._getframe(1)
+            fname = os.path.abspath(frame.f_code.co_filename)
+            if fname == _THIS_FILE or not fname.startswith(self.scope_root):
+                return inner  # stdlib / third-party lock: leave it raw
+            name = f"{os.path.relpath(fname, self.scope_root)}:{frame.f_lineno}"
+            return _CheckedLock(inner, self, name, reentrant)
+
+        return make_lock
+
+    # ------------------------------------------------------------------ #
+    # Sink binding
+    # ------------------------------------------------------------------ #
+
+    def bind_sink(self, sink) -> None:
+        """Attach the telemetry sink; violations recorded before the sink
+        existed (locks are instrumented from process start) are flushed."""
+        with self._meta_lock:
+            self._sink = sink
+            pending, self._buffered = self._buffered, []
+        for v in pending:
+            self._log(v)
+
+    # ------------------------------------------------------------------ #
+    # Hot-path hooks
+    # ------------------------------------------------------------------ #
+
+    def _held(self) -> List[_CheckedLock]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _on_acquired(self, lock: _CheckedLock) -> None:
+        held = self._held()
+        already = any(h is lock for h in held)
+        if not already and not getattr(self._tls, "emitting", False):
+            site = self._site()
+            for h in {h.name: h for h in held}.values():
+                if h.name == lock.name:
+                    continue
+                edge = (h.name, lock.name)
+                pair = frozenset(edge)
+                witness = None
+                with self._meta_lock:
+                    self._edges.setdefault(edge, site)
+                    rev = self._edges.get((lock.name, h.name))
+                    if rev is not None and pair not in self._reported:
+                        self._reported.add(pair)
+                        witness = rev
+                if witness is not None:
+                    self._emit({
+                        "kind": "lock_order_inversion",
+                        "thread": threading.current_thread().name,
+                        "site": site,
+                        "lock": lock.name,
+                        "other": h.name,
+                        "witness": witness,
+                        "held": [x.name for x in held],
+                    })
+        held.append(lock)
+
+    def _on_released(self, lock: _CheckedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def _on_blocking(self, call: str) -> None:
+        if getattr(self._tls, "emitting", False):
+            return
+        held = self._held()
+        if not held:
+            return
+        self._emit({
+            "kind": "lock_held_blocking",
+            "thread": threading.current_thread().name,
+            "site": self._site(),
+            "call": call,
+            "held": sorted({h.name for h in held}),
+        })
+
+    # ------------------------------------------------------------------ #
+    # Reporting
+    # ------------------------------------------------------------------ #
+
+    def _site(self) -> str:
+        frame = sys._getframe(1)
+        while frame is not None:
+            fname = os.path.abspath(frame.f_code.co_filename)
+            if fname != _THIS_FILE:
+                if fname.startswith(self.scope_root):
+                    rel = os.path.relpath(fname, self.scope_root)
+                    return f"{rel}:{frame.f_lineno}"
+                return f"{os.path.basename(fname)}:{frame.f_lineno}"
+            frame = frame.f_back
+        return "<unknown>"  # pragma: no cover
+
+    def _emit(self, violation: dict) -> None:
+        with self._meta_lock:
+            self.violations.append(violation)
+            sink = self._sink
+            if sink is None:
+                self._buffered.append(violation)
+        if sink is not None:
+            self._log(violation)
+
+    def _log(self, violation: dict) -> None:
+        # Suppress instrumentation reentrancy: the sink itself may take
+        # instrumented locks (FlightSink tees into the flight ring), and
+        # those acquisitions must not recurse into violation emission.
+        self._tls.emitting = True
+        try:
+            with self._meta_lock:
+                sink = self._sink
+            if sink is not None:
+                sink.log("thread_violation", **violation)
+        finally:
+            self._tls.emitting = False
+
+
+# --------------------------------------------------------------------------- #
+# Process-global install
+# --------------------------------------------------------------------------- #
+
+
+def install(sink=None, scope_root: Optional[str] = None) -> ThreadCheck:
+    """Install the sentinel process-wide (idempotent).  Install *early* —
+    only locks created after this call are instrumented — then
+    ``bind_sink()`` once the telemetry sink exists."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        if sink is not None:
+            _ACTIVE.bind_sink(sink)
+        return _ACTIVE
+    check = ThreadCheck(sink=sink, scope_root=scope_root)
+    check._install()
+    _ACTIVE = check
+    return check
+
+
+def uninstall() -> None:
+    """Restore the patched factories/methods (locks already handed out stay
+    wrapped but report into the now-inactive checker's lists)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE._uninstall()
+        _ACTIVE = None
+
+
+def active() -> Optional[ThreadCheck]:
+    return _ACTIVE

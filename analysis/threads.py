@@ -1,0 +1,569 @@
+"""threadlint: interprocedural lock-discipline analysis (JL303-JL306).
+
+Stdlib-only, like the rest of jaxlint.  The model is Eraser-style lockset
+inference scoped to a class (the unit of shared state in this codebase):
+
+* **Lock identity.**  ``self._lock`` acquired via ``with`` inside class ``C``
+  is the lock ``C._lock``; module-level ``with SOME_LOCK:`` is
+  ``<module>.SOME_LOCK``.  An attribute counts as a lock when it is assigned
+  ``threading.Lock()/RLock()`` anywhere in the class or its name contains
+  ``lock`` (matching JL301's convention).
+* **Entry locksets (interprocedural).**  A private helper's
+  held-on-entry lockset is the *intersection* over every intra-class call
+  site of (caller's entry lockset | locks lexically held at the call).
+  Public and dunder methods, thread targets, and methods invoked from
+  another class's thread side start with the empty set — anyone may call
+  them.  Computed to a fixed point; a site's lockset is then
+  ``entry(method) | lexically-held``.
+* **Thread sides.**  The producer side of a class is the transitive
+  self-call closure of its ``threading.Thread(target=self.X)`` targets plus
+  any of its methods invoked as ``self.<attr>.<m>(...)`` from *another*
+  class's producer side (so ``FlightRecorder.dump`` is thread-side because
+  the heartbeat daemon calls ``self.flight.dump(...)``).  The consumer side
+  is the closure of everything else (minus ``__init__``).
+* **Acquisition-order graph.**  Acquiring ``B`` while holding ``A`` (either
+  lexically nested ``with`` blocks or by calling a helper whose transitive
+  acquire set contains ``B``) adds the edge ``A -> B``, accumulated across
+  the whole project.  An edge whose reverse is reachable is a static
+  deadlock (JL303).
+
+Rules (see README "Static analysis"):
+
+* JL303 — lock-order inversion: the acquisition-order graph has a cycle.
+* JL304 — blocking call (``Future.result``, blocking ``queue.get``,
+  ``join``, ``Event/Condition.wait``, file I/O, ``time.sleep``,
+  subprocess) at a site whose lockset is non-empty.
+* JL305 — inconsistent locksets: a shared attribute (accessed on both
+  thread sides, written outside ``__init__``) whose candidate lockset —
+  the intersection of the locksets of *all* its access sites — is empty.
+  The interprocedural generalization of JL301 (which only sees writes).
+* JL306 — a thread-side method truncate-writes a file (``open(p, "w")``)
+  without the atomic tmp + ``os.replace`` idiom, so a concurrent reader or
+  a crash can observe a torn file.  Append mode is exempt (the JSONL sink
+  idiom); a method that ``os.replace``/``os.rename``-publishes is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+_LOCK_CTORS = {"Lock", "RLock"}
+# Attributes assigned one of these are synchronization/thread objects, not
+# shared mutable state — accessing them lock-free is their entire point.
+_SAFE_CTORS = {
+    "Lock", "RLock", "Event", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "Thread", "Timer", "local", "ThreadPoolExecutor", "ProcessPoolExecutor",
+}
+
+Site = Tuple[str, int, int]  # path, line, col
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _closure(roots: Set[str], calls: Dict[str, Set[str]]) -> Set[str]:
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        for callee in calls.get(frontier.pop(), ()):
+            if callee in calls and callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    """Human-readable name when ``call`` can block indefinitely, else None."""
+    f = call.func
+    d = _dotted(f)
+    if isinstance(f, ast.Name) and f.id == "open":
+        return "open()"
+    if d in ("time.sleep", "sleep"):
+        return "time.sleep()"
+    if d == "os.fsync":
+        return "os.fsync()"
+    if d and d.startswith("subprocess."):
+        return f"{d}()"
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = _dotted(f.value) or ""
+    leaf = recv.split(".")[-1].lower()
+    if f.attr == "result":
+        return f"{recv or '<future>'}.result()"
+    if f.attr == "get" and ("queue" in leaf or leaf in ("q", "_q")):
+        return f"{recv}.get()"
+    if f.attr in ("join", "wait") and isinstance(f.value, (ast.Name,
+                                                          ast.Attribute)):
+        if f.attr == "wait":
+            return f"{recv}.wait()"
+        # ``.join``: separators take an iterable; threads take nothing or a
+        # numeric timeout.  (os.path.join takes string parts -> excluded.)
+        numeric = (len(call.args) == 1
+                   and isinstance(call.args[0], ast.Constant)
+                   and isinstance(call.args[0].value, (int, float)))
+        if (not call.args and not call.keywords) or numeric \
+                or any(k.arg == "timeout" for k in call.keywords):
+            return f"{recv}.join()"
+    return None
+
+
+class _MethodScan:
+    """Lexical facts of one method: lock acquisitions, self-calls, attribute
+    accesses, blocking calls and truncate-writes, each with the tuple of
+    lock ids *lexically* held at the site."""
+
+    def __init__(self, fn: ast.AST, resolve_lock) -> None:
+        self.fn = fn
+        self.acquires: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.self_calls: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.accesses: List[Tuple[str, ast.AST, Tuple[str, ...], bool]] = []
+        self.blocking: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.truncate_opens: List[Tuple[ast.AST, str]] = []
+        self.chained_calls: List[Tuple[str, str]] = []  # (self.<attr>, method)
+        self.has_rename = False
+        self._resolve = resolve_lock
+        self._visit(fn, ())
+
+    def _visit(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self.fn:
+            return  # nested defs run in their own (unknown) context
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._visit(item.context_expr, held)
+                lid = self._resolve(item.context_expr)
+                if lid is not None:
+                    self.acquires.append((lid, item.context_expr, held))
+                    held = held + (lid,)
+            for child in node.body:
+                self._visit(child, held)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                self.self_calls.append((f.attr, node, held))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self":
+                self.chained_calls.append((f.value.attr, f.attr))
+            d = _dotted(f)
+            if d in ("os.replace", "os.rename"):
+                self.has_rename = True
+            desc = _blocking_desc(node)
+            if desc is not None:
+                self.blocking.append((desc, node, held))
+            if isinstance(f, ast.Name) and f.id == "open" \
+                    and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str) \
+                    and any(c in node.args[1].value for c in "wx"):
+                self.truncate_opens.append((node, node.args[1].value))
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.accesses.append((node.attr, node, held, write))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+
+class _ClassModel:
+    """One class's methods, locks, thread sides, and inferred locksets."""
+
+    def __init__(self, path: str, modstem: str, cls: Optional[ast.ClassDef],
+                 module_locks: Set[str],
+                 functions: Optional[List[ast.FunctionDef]] = None) -> None:
+        self.path = path
+        self.modstem = modstem
+        self.name = cls.name if cls is not None else f"<{modstem}>"
+        body = cls.body if cls is not None else (functions or [])
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.is_class = cls is not None
+        self.lock_attrs: Set[str] = set()
+        self.safe_attrs: Set[str] = set()
+        self.targets: Set[str] = set()
+        scan_root = cls if cls is not None else None
+        if scan_root is not None:
+            for node in ast.walk(scan_root):
+                tgt, val = None, None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    tgt, val = node.target, node.value
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and isinstance(val, ast.Call):
+                    ctor = (_dotted(val.func) or "").split(".")[-1]
+                    if ctor in _SAFE_CTORS:
+                        self.safe_attrs.add(tgt.attr)
+                    if ctor in _LOCK_CTORS:
+                        self.lock_attrs.add(tgt.attr)
+                if isinstance(node, ast.Call) and \
+                        (_dotted(node.func) or "").split(".")[-1] == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target" and \
+                                isinstance(kw.value, ast.Attribute) and \
+                                isinstance(kw.value.value, ast.Name) and \
+                                kw.value.value.id == "self":
+                            self.targets.add(kw.value.attr)
+        self._module_locks = module_locks
+        self.scans: Dict[str, _MethodScan] = {
+            name: _MethodScan(fn, self._resolve_lock)
+            for name, fn in self.methods.items()
+        }
+        self.calls: Dict[str, Set[str]] = {
+            name: {c for c, _, _ in scan.self_calls}
+            for name, scan in self.scans.items()
+        }
+        # Filled in by finalize() once cross-class thread entries are known.
+        self.entered: Set[str] = set()
+        self.producer: Set[str] = set()
+        self.consumer: Set[str] = set()
+        self.entry: Dict[str, FrozenSet[str]] = {}
+        self.acq_star: Dict[str, Set[str]] = {}
+
+    # -- lock identity ------------------------------------------------- #
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        d = _dotted(expr)
+        if d and d.startswith("self.") and d.count(".") == 1:
+            attr = d.split(".", 1)[1]
+            if attr in self.lock_attrs or "lock" in attr.lower():
+                return f"{self.name}.{attr}"
+            return None
+        if d is not None:
+            if d in self._module_locks:
+                return f"{self.modstem}.{d}"
+            if "lock" in d.lower():
+                return f"{self.modstem}.{d}"
+            return None
+        if isinstance(expr, ast.Call):
+            # ``with open(".build.lock", "w")`` and friends are file handles
+            # (cross-process fcntl locks at most), not threading locks.
+            return None
+        try:
+            txt = ast.unparse(expr)
+        except Exception:  # pragma: no cover  # jaxlint: disable=JL302 -- ast.unparse on exotic/synthetic nodes; no lock id is the designed fallback
+            return None
+        return txt if "lock" in txt.lower() else None
+
+    def lockish_attrs(self) -> Set[str]:
+        out = set(self.lock_attrs)
+        for scan in self.scans.values():
+            for attr, _, _, _ in scan.accesses:
+                if "lock" in attr.lower():
+                    out.add(attr)
+        return out
+
+    # -- interprocedural inference ------------------------------------- #
+
+    def finalize(self, thread_entered: Set[str]) -> None:
+        self.entered = thread_entered & set(self.methods)
+        self.producer = _closure(self.targets | self.entered, self.calls)
+        self.consumer = _closure(
+            set(self.methods) - self.targets - {"__init__"}, self.calls)
+        roots = {m for m in self.methods
+                 if not m.startswith("_") or m.startswith("__")}
+        roots |= self.targets | self.entered | {"__init__"}
+        sites: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        for caller, scan in self.scans.items():
+            for callee, _, held in scan.self_calls:
+                if callee in self.methods:
+                    sites.setdefault(callee, []).append((caller, held))
+        entry: Dict[str, Optional[FrozenSet[str]]] = {
+            m: (frozenset() if m in roots else None) for m in self.methods
+        }
+        for _ in range(len(self.methods) + 2):
+            changed = False
+            for m in self.methods:
+                if m in roots:
+                    continue
+                vals = [entry[c] | frozenset(h) for c, h in sites.get(m, [])
+                        if entry[c] is not None]
+                if not vals:
+                    continue
+                new = frozenset.intersection(*vals)
+                if new != entry[m]:
+                    entry[m] = new
+                    changed = True
+            if not changed:
+                break
+        self.entry = {m: e or frozenset() for m, e in entry.items()}
+        # Transitive acquire sets, for call-edge construction.
+        acq = {m: {lid for lid, _, _ in scan.acquires}
+               for m, scan in self.scans.items()}
+        for _ in range(len(self.methods) + 2):
+            changed = False
+            for m in self.methods:
+                for callee in self.calls.get(m, ()):
+                    if callee in acq and not acq[callee] <= acq[m]:
+                        acq[m] |= acq[callee]
+                        changed = True
+            if not changed:
+                break
+        self.acq_star = acq
+
+    def site_lockset(self, method: str, held: Tuple[str, ...]) -> FrozenSet[str]:
+        return self.entry.get(method, frozenset()) | frozenset(held)
+
+    def order_edges(self) -> Iterable[Tuple[str, str, Site]]:
+        """(held, acquired, site) pairs, interprocedural within the class."""
+        for m, scan in self.scans.items():
+            for lid, node, held in scan.acquires:
+                full = self.site_lockset(m, held)
+                for h in full:
+                    if h != lid:
+                        yield (h, lid,
+                               (self.path, node.lineno, node.col_offset))
+            for callee, node, held in scan.self_calls:
+                if callee not in self.methods:
+                    continue
+                full = self.site_lockset(m, held)
+                if not full:
+                    continue
+                for acquired in self.acq_star.get(callee, ()) - full:
+                    for h in full:
+                        yield (h, acquired,
+                               (self.path, node.lineno, node.col_offset))
+
+
+class ThreadIndex:
+    """Project-wide thread model: per-module class models, the set of method
+    names invoked from any thread side, and the global acquisition-order
+    graph."""
+
+    def __init__(self) -> None:
+        self.models_by_path: Dict[str, List[_ClassModel]] = {}
+        self.thread_entered: Set[str] = set()
+        self.edges: Dict[Tuple[str, str], List[Site]] = {}
+        self._inversions: Optional[Dict[Tuple[str, str], Site]] = None
+
+    @classmethod
+    def build(cls, modules: Iterable[Tuple[str, ast.Module]]) -> "ThreadIndex":
+        idx = cls()
+        mods = list(modules)
+        for path, tree in mods:
+            modstem = path.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+            module_locks = set()
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call) \
+                        and (_dotted(node.value.func) or "").split(".")[-1] \
+                        in _LOCK_CTORS:
+                    module_locks.add(node.targets[0].id)
+            models = [
+                _ClassModel(path, modstem, n, module_locks)
+                for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+            ]
+            funcs = [n for n in tree.body
+                     if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            if funcs:
+                models.append(_ClassModel(path, modstem, None, module_locks,
+                                          functions=funcs))
+            idx.models_by_path[path] = models
+        # Which method names does any thread side call on a held object
+        # (``self.<attr>.<m>(...)``)?  Name-keyed across the project, like
+        # ProjectIndex.donating_attrs.
+        for models in idx.models_by_path.values():
+            for model in models:
+                if not model.targets:
+                    continue
+                for m in _closure(set(model.targets), model.calls):
+                    scan = model.scans.get(m)
+                    if scan is None:
+                        continue
+                    for attr, meth in scan.chained_calls:
+                        if attr not in model.safe_attrs \
+                                and attr not in model.lockish_attrs():
+                            idx.thread_entered.add(meth)
+        for models in idx.models_by_path.values():
+            for model in models:
+                model.finalize(idx.thread_entered)
+                for a, b, site in model.order_edges():
+                    self_edges = idx.edges.setdefault((a, b), [])
+                    self_edges.append(site)
+        return idx
+
+    # -- cycle detection ------------------------------------------------ #
+
+    def inversions(self) -> Dict[Tuple[str, str], Site]:
+        """Edges that participate in a cycle, mapped to a witness site of
+        the *reverse* direction (lazily computed, cached)."""
+        if self._inversions is not None:
+            return self._inversions
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        reach: Dict[str, Set[str]] = {}
+
+        def reachable(src: str) -> Set[str]:
+            if src not in reach:
+                seen: Set[str] = set()
+                frontier = [src]
+                while frontier:
+                    for nxt in adj.get(frontier.pop(), ()):
+                        if nxt not in seen:
+                            seen.add(nxt)
+                            frontier.append(nxt)
+                reach[src] = seen
+            return reach[src]
+
+        out: Dict[Tuple[str, str], Site] = {}
+        for (a, b), _sites in self.edges.items():
+            if a in reachable(b):  # b -> ... -> a exists: (a, b) closes a cycle
+                if (b, a) in self.edges:
+                    out[(a, b)] = self.edges[(b, a)][0]
+                else:
+                    witness = next(self.edges[(b, nxt)][0]
+                                   for nxt in adj.get(b, ())
+                                   if a in reachable(nxt) or nxt == a
+                                   if (b, nxt) in self.edges)
+                    out[(a, b)] = witness
+        self._inversions = out
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# Rules
+# --------------------------------------------------------------------------- #
+
+
+def run_thread_rules(path: str, tree: ast.Module, threads: ThreadIndex,
+                     out: List[Finding]) -> None:
+    _run_lock_order(path, threads, out)
+    for model in threads.models_by_path.get(path, []):
+        _run_blocking_under_lock(model, out)
+        if model.is_class:
+            _run_inconsistent_locksets(model, out)
+            _run_torn_thread_write(model, out)
+
+
+def _run_lock_order(path: str, threads: ThreadIndex,
+                    out: List[Finding]) -> None:
+    inv = threads.inversions()
+    seen: Set[Tuple[int, str, str]] = set()
+    for (a, b), witness in sorted(inv.items()):
+        for spath, line, col in threads.edges[(a, b)]:
+            if spath != path or (line, a, b) in seen:
+                continue
+            seen.add((line, a, b))
+            wpath, wline, _ = witness
+            out.append(Finding(
+                path, line, col, "JL303",
+                f"lock-order inversion: `{b}` is acquired while holding "
+                f"`{a}` here, but the opposite order is taken at "
+                f"{wpath}:{wline} — two threads taking the two paths "
+                "deadlock; pick one global acquisition order",
+            ))
+
+
+def _run_blocking_under_lock(model: _ClassModel, out: List[Finding]) -> None:
+    for m, scan in model.scans.items():
+        for desc, node, held in scan.blocking:
+            full = model.site_lockset(m, held)
+            if not full:
+                continue
+            locks = ", ".join(f"`{lk}`" for lk in sorted(full))
+            out.append(Finding(
+                model.path, node.lineno, node.col_offset, "JL304",
+                f"blocking call `{desc}` while holding {locks} — a stall "
+                "here freezes every thread contending for the lock; move "
+                "the blocking work outside the critical section",
+            ))
+
+
+def _run_inconsistent_locksets(model: _ClassModel, out: List[Finding]) -> None:
+    if not (model.lock_attrs or model.targets):
+        return
+    skip = model.lockish_attrs() | model.safe_attrs | set(model.methods)
+    # Per-attribute access sites outside __init__.
+    sites: Dict[str, List[Tuple[str, ast.AST, FrozenSet[str], bool]]] = {}
+    for m, scan in model.scans.items():
+        if m == "__init__":
+            continue
+        for attr, node, held, write in scan.accesses:
+            if attr in skip:
+                continue
+            sites.setdefault(attr, []).append(
+                (m, node, model.site_lockset(m, held), write))
+    has_thread_side = bool(model.targets or model.entered)
+    for attr, accs in sorted(sites.items()):
+        if not any(write for _, _, _, write in accs):
+            continue  # never mutated after __init__: effectively immutable
+        if has_thread_side:
+            if not (any(m in model.producer for m, _, _, _ in accs)
+                    and any(m in model.consumer for m, _, _, _ in accs)):
+                continue  # one side only: no cross-thread sharing observed
+            # JL301 already covers unlocked *writes* on both sides; do not
+            # double-report the same attribute.
+            prod_w = [a for a in accs if a[0] in model.producer and a[3]]
+            cons_w = [a for a in accs if a[0] in model.consumer and a[3]]
+            if prod_w and cons_w and any(not ls for _, _, ls, _ in
+                                         prod_w + cons_w):
+                continue
+        locked = [a for a in accs if a[2]]
+        unlocked = [a for a in accs if not a[2]]
+        if not unlocked:
+            continue  # candidate lockset may be non-empty; check it
+        if frozenset.intersection(*[ls for _, _, ls, _ in accs]):
+            continue  # one lock consistently guards every site
+        if not locked:
+            # Never guarded anywhere: only report when the class both has a
+            # thread side and synchronizes *other* state with a lock —
+            # otherwise single-threaded classes would drown the signal.
+            if not (has_thread_side and model.lock_attrs):
+                continue
+            _, node, _, _ = unlocked[0]
+            lock = sorted(model.lock_attrs)[0]
+            out.append(Finding(
+                model.path, node.lineno, node.col_offset, "JL305",
+                f"`self.{attr}` is shared with the thread side of "
+                f"`{model.name}` but no lock ever guards it, although the "
+                f"class synchronizes other state with `self.{lock}` — "
+                "guard every access or route the value through a queue",
+            ))
+            continue
+        lm, lnode, lls, _ = locked[0]
+        _, node, _, _ = unlocked[0]
+        guard = sorted(lls)[0]
+        out.append(Finding(
+            model.path, node.lineno, node.col_offset, "JL305",
+            f"`self.{attr}` is accessed under `{guard}` at line "
+            f"{lnode.lineno} (in `{lm}`) but lock-free here — its candidate "
+            "lockset is empty, so two threads can interleave on it; hold "
+            "the same lock at every access",
+        ))
+
+
+def _run_torn_thread_write(model: _ClassModel, out: List[Finding]) -> None:
+    if not (model.targets or model.entered):
+        return
+    for m in model.producer:
+        scan = model.scans.get(m)
+        if scan is None or scan.has_rename:
+            continue
+        for node, mode in scan.truncate_opens:
+            out.append(Finding(
+                model.path, node.lineno, node.col_offset, "JL306",
+                f"thread-side `open(..., {mode!r})` without the atomic "
+                "tmp + os.replace idiom — a concurrent reader (or a crash "
+                "mid-write) observes a torn file; write to a temp path in "
+                "the same directory and os.replace it into place",
+            ))

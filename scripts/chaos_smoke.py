@@ -110,6 +110,10 @@ def main() -> int:
             "--ckpt_dir", ckpt_dir,
             "--epoch_ckpt_every", "1",
             "--fault_spec", "kill@task1.epoch2",
+            # The chaos run doubles as the ThreadCheck acceptance run: the
+            # heartbeat/flight/prefetch locks are instrumented and any
+            # inversion or lock-held blocking would emit thread_violation.
+            "--check_threads",
         ]
         chaos = subprocess.run(chaos_cmd, cwd=_REPO, timeout=900)
 
@@ -129,6 +133,12 @@ def main() -> int:
                 and resume.get("start_epoch") == 2):
             failures.append(
                 f"resume was not epoch-granular at task1/epoch2: {resume}")
+
+        tviol = [r for r in chaos_recs if r.get("type") == "thread_violation"]
+        if tviol:
+            failures.append(
+                f"{len(tviol)} thread_violation record(s) under "
+                f"--check_threads: {tviol[:3]}")
 
         twin_final = _last(twin_recs, "final")
         chaos_final = _last(chaos_recs, "final")

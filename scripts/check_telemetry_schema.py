@@ -42,6 +42,16 @@ SCHEMA = {
         {"task": NUM, "epoch": NUM, "step": NUM},
         None,
     ),
+    # ThreadCheck sentinel (analysis/threadcheck.py, --check_threads): a
+    # lock-order inversion or lock-held blocking call observed at runtime.
+    # kind is lock_order_inversion (lock/other/witness set) or
+    # lock_held_blocking (call set); the chaos/serve smokes fail on any.
+    "thread_violation": (
+        {"kind": str, "thread": str, "site": str},
+        {"lock": str, "other": str, "witness": str, "call": str,
+         "held": list},
+        None,
+    ),
     # Prefetch producer death -> synchronous-path degradation
     # (data/prefetch.py on_degrade hook, wired in engine/loop.py).
     "prefetch_degraded": (

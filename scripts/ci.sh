@@ -11,10 +11,12 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/10: jaxlint (JAX-hazard static analysis) =="
-# Fails on any finding not in analysis/jaxlint_baseline.json.  After fixing
-# or justifying findings, refresh with: python scripts/jaxlint.py --write-baseline
-python scripts/jaxlint.py || exit 1
+echo "== stage 1/10: jaxlint (JAX-hazard + lock-discipline static analysis) =="
+# Fails on any finding not in analysis/jaxlint_baseline.json, and
+# (--check-baseline) on any baseline entry that no longer matches a live
+# finding — suppressions must not rot.  After fixing or justifying
+# findings, refresh with: python scripts/jaxlint.py --write-baseline
+python scripts/jaxlint.py --check-baseline || exit 1
 
 echo "== stage 2/10: ruff + mypy (skipped when not installed) =="
 # Configured in pyproject.toml; the container does not bake these in, so the
@@ -81,6 +83,8 @@ echo "== stage 6/10: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # accuracy matrix must be bit-identical to its fault-free twin — the
 # acceptance proof for the fault-injection / epoch-checkpoint / supervisor
 # stack (faults/injector.py, utils/checkpoint.py, scripts/supervise.py).
+# The chaos run executes under --check_threads and must emit zero
+# thread_violation records (analysis/threadcheck.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 
 echo "== stage 7/10: CPU serve smoke (export + hot-swap under fire) =="
@@ -90,6 +94,8 @@ echo "== stage 7/10: CPU serve smoke (export + hot-swap under fire) =="
 # serve_swap_failed), the retry must swap cleanly, no request may fail, the
 # exported programs must be bit-identical to direct model calls, and the
 # serving hot path must run zero traces (serving/, scripts/serve_smoke.py).
+# Both the training child and the in-process server run under the
+# ThreadCheck sentinel and must emit zero thread_violation records.
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || exit 1
 
 echo "== stage 8/10: perf regression gate (bench.py vs BASELINE.json) =="

@@ -11,7 +11,9 @@ Usage:
 Exit codes: 0 = no findings outside the baseline; 1 = new findings (printed
 as ``path:line:col: RULE message``); 2 = usage error.  Stale baseline
 entries (fixed findings still listed) are warned about but do not fail —
-refresh with ``--write-baseline``.
+refresh with ``--write-baseline`` — unless ``--check-baseline`` is given
+(the CI mode: a rotted suppression fails the run so the baseline always
+matches reality).
 
 Stdlib-only: this never imports jax, so the lint stage runs anywhere.
 """
@@ -45,6 +47,9 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="rewrite the baseline to the current findings "
                         "(keeps reasons of entries that still match)")
+    parser.add_argument("--check-baseline", action="store_true",
+                        help="fail (exit 1) when a baseline entry no longer "
+                        "matches any live finding, instead of only warning")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -80,6 +85,11 @@ def main(argv=None) -> int:
     for e in stale:
         print(f"jaxlint: stale baseline entry (fixed? refresh with "
               f"--write-baseline): {e['path']}:{e['line']} {e['rule']}")
+    if stale and args.check_baseline:
+        print(f"jaxlint: --check-baseline: {len(stale)} stale baseline "
+              "entr(y/ies) no longer match any live finding; remove them or "
+              "refresh with --write-baseline")
+        return 1
     if new:
         print(f"jaxlint: {len(new)} new finding(s) in {len(set(f.path for f in new))} "
               "file(s); fix them, add '# jaxlint: disable=<rule>' with a reason, "

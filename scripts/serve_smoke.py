@@ -78,7 +78,7 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
         train_log = os.path.join(tmp, "train.jsonl")
         train_cmd = [sys.executable, os.path.join(_REPO, "train.py"),
                      *_PROTO, "--export_dir", export_dir,
-                     "--log_file", train_log]
+                     "--log_file", train_log, "--check_threads"]
         train = subprocess.run(train_cmd, cwd=_REPO, timeout=900)
         if train.returncode != 0:
             print(json.dumps({"metric": "serve_smoke", "ok": False,
@@ -151,8 +151,17 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
                         os.path.join(serve_dir, "task_000"))
         register_artifact(serve_dir, 0, {"path": "task_000"})
 
+        # The whole serve-under-fire scenario runs under the ThreadCheck
+        # sentinel: the server's lock (created below, post-install) is
+        # instrumented, and any lock-order inversion or lock-held blocking
+        # on the batcher/watcher/client threads emits thread_violation.
+        from analysis import threadcheck
+
+        check = threadcheck.install()
+
         serve_log = os.path.join(tmp, "serve.jsonl")
         sink = JsonlLogger(serve_log)
+        check.bind_sink(sink)
         inj = FaultInjector(
             parse_fault_spec("swap_ioerror@task1"),
             ledger_path=os.path.join(tmp, "fault_ledger.jsonl"),
@@ -232,6 +241,17 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
                     "server response logits differ from the direct model call")
         finally:
             server2.stop()
+
+        # Hot-swap under fire must have been lock-discipline clean: zero
+        # thread_violation records (and none in the training child's log —
+        # it ran under --check_threads too).
+        threadcheck.uninstall()
+        tviol = [r for r in _records(serve_log) + train_recs
+                 if r.get("type") == "thread_violation"]
+        if check.violations or tviol:
+            failures.append(
+                f"ThreadCheck violations under traffic: "
+                f"{(check.violations + tviol)[:3]}")
 
         # Every telemetry stream the scenario produced must pass the lint.
         lint = subprocess.run(

@@ -472,6 +472,304 @@ def test_jl302_suppression_comment(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# JL303 — lock-order inversion (interprocedural acquisition-order graph)
+# --------------------------------------------------------------------------- #
+
+
+def test_jl303_abba_inversion(tmp_path):
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Swap:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert rules_of(findings) == ["JL303"]
+    # Both directions of the cycle are reported, each at its acquire site.
+    assert sorted(f.line for f in findings) == [11, 16]
+    assert all("inversion" in f.message for f in findings)
+
+
+def test_jl303_inversion_through_self_call(tmp_path):
+    # The second lock is taken in a *callee*, not lexically — the edge must
+    # come from the interprocedural transitive-acquire set.
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Swap:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self._locked_b()
+
+            def _locked_b(self):
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert rules_of(findings) == ["JL303"]
+    assert 19 in {f.line for f in findings}  # the reverse acquire in two()
+
+
+def test_jl303_consistent_order_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Swap:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL304 — blocking call while holding a lock
+# --------------------------------------------------------------------------- #
+
+
+def test_jl304_queue_get_under_lock(tmp_path):
+    findings = run_lint(tmp_path, """
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = queue.Queue()
+
+            def drain(self):
+                with self._lock:
+                    return self._queue.get()
+        """)
+    assert rules_of(findings) == ["JL304"]
+    (f,) = findings
+    assert f.line == 12 and "Worker._lock" in f.message
+
+
+def test_jl304_get_outside_lock_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = queue.Queue()
+
+            def drain(self):
+                item = self._queue.get()
+                with self._lock:
+                    return item
+        """)
+    assert findings == []
+
+
+def test_jl304_join_and_file_io_under_lock(tmp_path):
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                with self._lock:
+                    self._t.join()
+
+            def dump(self, path):
+                with self._lock:
+                    with open(path, "w") as f:
+                        f.write("x")
+        """)
+    assert rules_of(findings) == ["JL304"]
+    assert sorted(f.line for f in findings) == [14, 18]
+
+
+def test_jl304_str_join_is_clean(tmp_path):
+    # str.join / os.path.join are not thread joins.
+    findings = run_lint(tmp_path, """
+        import os
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def render(self, parts):
+                with self._lock:
+                    return os.path.join("/tmp", ", ".join(parts))
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL305 — inconsistent locksets (interprocedural JL301)
+# --------------------------------------------------------------------------- #
+
+
+def test_jl305_unlocked_read_races_cadence(tmp_path):
+    # The telemetry/heartbeat.py bug this rule caught in the real tree:
+    # the daemon writes `_last` under the lock, update() read it bare.
+    findings = run_lint(tmp_path, """
+        import threading
+        import time
+
+        class Beat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._last = 0.0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self._last = time.monotonic()
+
+            def update(self):
+                if time.monotonic() - self._last > 1.0:
+                    with self._lock:
+                        self._last = time.monotonic()
+        """)
+    assert rules_of(findings) == ["JL305"]
+    (f,) = findings
+    assert f.line == 17 and "_last" in f.message and "Beat._lock" in f.message
+
+
+def test_jl305_every_access_locked_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import threading
+        import time
+
+        class Beat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._last = 0.0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self._last = time.monotonic()
+
+            def update(self):
+                with self._lock:
+                    if time.monotonic() - self._last > 1.0:
+                        self._last = time.monotonic()
+        """)
+    assert findings == []
+
+
+def test_jl305_lock_free_class_is_clean(tmp_path):
+    # No locks, no threads: plain single-threaded state is out of scope.
+    findings = run_lint(tmp_path, """
+        class Counter:
+            def __init__(self):
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+
+            def peek(self):
+                return self._n
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL306 — thread-side truncate-write without atomic rename
+# --------------------------------------------------------------------------- #
+
+
+def test_jl306_daemon_truncate_write(tmp_path):
+    findings = run_lint(tmp_path, """
+        import json
+        import threading
+
+        class Sink:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with open("state.json", "w") as f:
+                    json.dump({}, f)
+        """)
+    assert rules_of(findings) == ["JL306"]
+    (f,) = findings
+    assert f.line == 11 and "os.replace" in f.message
+
+
+def test_jl306_tmp_rename_idiom_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import json
+        import os
+        import threading
+
+        class Sink:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                tmp = "state.json.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({}, f)
+                os.replace(tmp, "state.json")
+        """)
+    assert findings == []
+
+
+def test_jl306_append_mode_is_clean(tmp_path):
+    # The JSONL sink idiom: appends are not torn by a concurrent reader.
+    findings = run_lint(tmp_path, """
+        import threading
+
+        class Sink:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with open("events.jsonl", "a") as f:
+                    f.write("{}")
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
 # suppressions / baseline / JL000
 # --------------------------------------------------------------------------- #
 
@@ -569,8 +867,27 @@ def test_cli_list_rules():
     )
     assert proc.returncode == 0
     for rule in ("JL001", "JL002", "JL101", "JL102", "JL103", "JL201",
-                 "JL301", "JL302"):
+                 "JL301", "JL302", "JL303", "JL304", "JL305", "JL306"):
         assert rule in proc.stdout
+
+
+def test_cli_check_baseline_fails_on_stale_entry(tmp_path):
+    """CI mode: a baseline entry whose finding was fixed must fail the run
+    (suppressions may not rot), while the default mode only warns."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    base = tmp_path / "base.json"
+    Baseline().write(
+        str(base),
+        [Finding(path="clean.py", line=1, col=0, rule="JL302", message="m")],
+    )
+    common = [sys.executable, f"{REPO}/scripts/jaxlint.py",
+              "--root", str(tmp_path), "--baseline", str(base), str(clean)]
+    warn = subprocess.run(common, capture_output=True, text=True)
+    assert warn.returncode == 0 and "stale" in warn.stdout
+    strict = subprocess.run([*common, "--check-baseline"],
+                            capture_output=True, text=True)
+    assert strict.returncode == 1 and "--check-baseline" in strict.stdout
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,244 @@
+"""ThreadCheck runtime sentinel (analysis/threadcheck.py, ``--check_threads``).
+
+The dynamic half of the lock-discipline story: seeded hazards must be
+flagged *deterministically* (the ABBA inversion is caught from the
+acquisition-order graph even when the interleaving never deadlocks), clean
+code must stay silent, every emitted ``thread_violation`` must pass the
+telemetry schema lint, and the real inference server must run clean under
+live traffic with the sentinel installed.
+
+Each test installs the process-global sentinel and uninstalls in
+``finally`` — the patched ``threading.Lock``/``queue.Queue.get``/
+``Future.result``/``Thread.join`` must never leak into other tests.
+"""
+
+import importlib.util
+import json
+import os
+import queue
+import sys
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from analysis import threadcheck
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_seeded_inversion_flagged_deterministically():
+    """a->b then b->a on ONE thread: no deadlock ever happens, but the
+    order graph has both edges — exactly one inversion is reported, with
+    the witness naming where the first direction was observed."""
+    check = threadcheck.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # the reverse direction
+                pass
+        assert [v["kind"] for v in check.violations] == [
+            "lock_order_inversion"
+        ]
+        v = check.violations[0]
+        assert v["lock"].startswith("tests/test_threadcheck.py:")
+        assert v["other"].startswith("tests/test_threadcheck.py:")
+        assert v["witness"].startswith("tests/test_threadcheck.py:")
+        assert v["thread"] == threading.current_thread().name
+        # Re-triggering the same pair does not re-report (one record per
+        # lock pair keeps a hot loop from flooding the sink).
+        with b:
+            with a:
+                pass
+        assert len(check.violations) == 1
+    finally:
+        threadcheck.uninstall()
+
+
+def test_seeded_lock_held_blocking_flagged():
+    check = threadcheck.install()
+    try:
+        lock = threading.Lock()
+        q = queue.Queue()
+        q.put("item")
+        fut = Future()
+        fut.set_result("done")
+        with lock:
+            assert q.get(timeout=1) == "item"  # blocking get under the lock
+            assert fut.result(timeout=1) == "done"
+        kinds = [(v["kind"], v["call"]) for v in check.violations]
+        assert kinds == [
+            ("lock_held_blocking", "queue.Queue.get"),
+            ("lock_held_blocking", "concurrent.futures.Future.result"),
+        ]
+        assert all(v["held"] for v in check.violations)
+    finally:
+        threadcheck.uninstall()
+
+
+def test_clean_usage_is_silent():
+    check = threadcheck.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        r = threading.RLock()
+        q = queue.Queue()
+        # Consistent a->b order, twice; blocking calls outside any lock;
+        # reentrant RLock re-acquire (no self-edge, no inversion).
+        for _ in range(2):
+            with a:
+                with b:
+                    pass
+        q.put(1)
+        assert q.get(timeout=1) == 1
+        with r:
+            with r:
+                pass
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        assert check.violations == []
+    finally:
+        threadcheck.uninstall()
+
+
+def test_out_of_scope_locks_stay_raw():
+    """Locks created by stdlib/third-party code are not instrumented: the
+    sentinel checks this repo's lock discipline, not CPython's."""
+    threadcheck.install()
+    try:
+        ours = threading.Lock()
+        assert type(ours).__name__ == "_CheckedLock"
+        # queue.Queue's internal mutex is created from queue.py (stdlib).
+        q = queue.Queue()
+        assert type(q.mutex).__name__ != "_CheckedLock"
+    finally:
+        threadcheck.uninstall()
+
+
+def test_emitted_records_pass_schema_lint(tmp_path):
+    """End-to-end record contract: violations recorded before the sink
+    exists are buffered, flushed on bind_sink, and every line written is a
+    schema-valid ``thread_violation``."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (  # noqa: E501
+        JsonlLogger,
+    )
+
+    schema = _load_script("check_telemetry_schema")
+    log = tmp_path / "tc.jsonl"
+    check = threadcheck.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # inversion recorded pre-sink -> buffered
+                pass
+        check.bind_sink(JsonlLogger(str(log), process_index=0,
+                                    process_count=1))
+        lock = threading.Lock()
+        q = queue.Queue()
+        q.put(1)
+        with lock:
+            q.get(timeout=1)  # blocking recorded post-sink -> direct
+    finally:
+        threadcheck.uninstall()
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert [r["type"] for r in recs] == ["thread_violation"] * 2
+    assert {r["kind"] for r in recs} == {
+        "lock_order_inversion", "lock_held_blocking"
+    }
+    for n, rec in enumerate(recs):
+        assert schema.check_record(rec, f"tc.jsonl:{n}") == []
+
+
+def test_uninstall_restores_factories():
+    originals = (threading.Lock, threading.RLock, queue.Queue.get,
+                 Future.result, threading.Thread.join)
+    check = threadcheck.install()
+    try:
+        assert threading.Lock is not originals[0]
+        assert threadcheck.active() is check
+        # install() is idempotent: a second call returns the same sentinel.
+        assert threadcheck.install() is check
+    finally:
+        threadcheck.uninstall()
+    assert (threading.Lock, threading.RLock, queue.Queue.get,
+            Future.result, threading.Thread.join) == originals
+    assert threadcheck.active() is None
+
+
+@pytest.mark.heavy  # AOT-exports a real artifact (cached in tests/.jax_cache)
+def test_real_server_under_traffic_is_clean(tmp_path):
+    """The acceptance half the smokes rely on: the inference server's
+    batcher/watcher/client threads run a full serve scenario under the
+    sentinel with zero violations."""
+    import jax
+    import numpy as np
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (  # noqa: E501
+        AugmentConfig,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+        grow,
+    )
+
+    # Install BEFORE building the server so its lock is instrumented.
+    check = threadcheck.install()
+    try:
+        from serving import InferenceServer, export_artifact
+
+        export_dir = str(tmp_path / "export")
+        os.makedirs(export_dir)
+        model, variables = create_model("resnet20", 10)
+        variables = grow(variables, jax.random.PRNGKey(0), 0, 5)
+        export_artifact(
+            export_dir, 0, model, AugmentConfig(),
+            variables["params"], variables["batch_stats"],
+            known=5, class_order=list(range(10)),
+            input_size=32, channels=3, buckets=(1, 4),
+            model_meta={"backbone": "resnet20", "width": 10,
+                        "compute_dtype": "float32", "bn_group_size": 0},
+        )
+        server = InferenceServer(export_dir, max_wait_ms=1.0,
+                                 poll_s=0.05).start()
+        try:
+            errors = []
+
+            def traffic(seed):
+                rng = np.random.RandomState(seed)
+                for _ in range(8):
+                    img = rng.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+                    try:
+                        server.submit(img).result(timeout=60)
+                    except Exception as e:  # noqa: BLE001 — asserted == []
+                        errors.append(repr(e))
+
+            clients = [threading.Thread(target=traffic, args=(s,))
+                       for s in range(2)]
+            for c in clients:
+                c.start()
+            for c in clients:
+                c.join()
+        finally:
+            server.stop()
+        assert errors == []
+        assert check.violations == []
+    finally:
+        threadcheck.uninstall()
