@@ -635,6 +635,17 @@ class CilTrainer:
                     pending = self._run_epoch_fused(
                         data_x, data_y, epoch_key, lr, lam, clock
                     )
+                    # The fused epoch is one opaque device program: the
+                    # per-step fire site never runs.  Settle step-level
+                    # clauses host-side now that the step count is known —
+                    # before the epoch-checkpoint hook, so a reconciled
+                    # kill@...step<S> still resumes from the PREVIOUS
+                    # epoch's checkpoint, same as a live mid-epoch kill.
+                    if self.faults is not None:
+                        self.faults.reconcile_steps(
+                            "engine.step", task=task_id, epoch=epoch + 1,
+                            steps=len(pending),
+                        )
                 else:
                     pending = self._run_epoch_steps(
                         task_id, task_train, epoch, epoch_key, lr, lam, clock

@@ -495,6 +495,189 @@ def measure_serve(duration_s: float = 4.0, workers: int = 8,
         shutil.rmtree(export_dir, ignore_errors=True)
 
 
+def measure_serve_overload(duration_s: float = 6.0, buckets=(1, 8),
+                           max_wait_ms: float = 3.0, pattern: str = "bursty",
+                           rps: float = 120.0, replicas: int = 2,
+                           high_frac: float = 0.3, capacity: int = 24,
+                           seed: int = 0) -> dict:
+    """Fleet traffic generator: bursty/diurnal arrivals + a priority mix
+    against in-process replicas behind the admission-controlled front end.
+
+    Open-loop by construction — arrivals follow a seeded Poisson clock whose
+    rate ``lambda(t)`` is modulated by ``pattern``:
+
+    * ``steady``  — constant ``rps``.
+    * ``bursty``  — on/off: 3x ``rps`` for the first 30% of every second,
+      ``rps``/3 otherwise (mean ~1.2x ``rps``); the shape that exercises
+      shedding and the high-class p99 under queue spikes.
+    * ``diurnal`` — one sinusoidal day compressed into the run:
+      ``rps * (1 + 0.9 sin(2 pi t / duration))``.
+
+    Each request is ``high`` priority with probability ``high_frac``, else
+    ``low``.  Reported per class: p50/p95/p99 of *successful* requests,
+    shed rate (HTTP 503 at admission), and errors (anything else — a
+    healthy fleet reports zero).  ``p99_high_ms`` is what
+    ``perf_gate.py --serve-overload`` gates: the whole point of shedding
+    low first is that the high-class tail stays flat through overload.
+    """
+    import math
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (
+        AugmentConfig,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+        grow,
+    )
+    from serving import export_artifact
+    from serving.frontend import Frontend
+    from serving.replica import ReplicaServer, encode_image
+
+    nb = 20
+    buckets = tuple(sorted({int(b) for b in buckets}))
+    export_dir = tempfile.mkdtemp(prefix="cil_serve_overload_")
+    fleet, frontend = [], None
+    try:
+        model, variables = create_model("resnet20", nb)
+        variables = grow(variables, jax.random.PRNGKey(0), 0, nb)
+        export_artifact(
+            export_dir, 0, model, AugmentConfig(),
+            variables["params"], variables["batch_stats"],
+            known=nb, class_order=list(range(nb)),
+            input_size=32, channels=3, buckets=buckets,
+        )
+        fleet = [
+            ReplicaServer(export_dir, replica_id=i,
+                          max_wait_ms=max_wait_ms).start()
+            for i in range(int(replicas))
+        ]
+        frontend = Frontend(
+            [(r.host, r.port) for r in fleet],
+            capacity=int(capacity),
+            default_deadline_ms=10000.0,
+        ).start()
+
+        rng = np.random.RandomState(seed)
+        body = encode_image(
+            rng.randint(0, 256, (32, 32, 3)).astype(np.uint8))
+        results = []
+        lock = threading.Lock()
+
+        def one(priority: str) -> None:
+            import http.client
+
+            t_req = time.perf_counter()
+            try:
+                conn = http.client.HTTPConnection(
+                    frontend.host, frontend.port, timeout=30.0)
+                try:
+                    conn.request("POST", "/predict", body=body, headers={
+                        "X-Priority": priority,
+                        "X-Deadline-Ms": "10000",
+                    })
+                    status = conn.getresponse()
+                    status.read()
+                    code = status.status
+                finally:
+                    conn.close()
+            except OSError:
+                code = -1
+            lat = (time.perf_counter() - t_req) * 1000.0
+            with lock:
+                results.append((priority, code, lat))
+
+        # Warm the whole path (connections, codec, batcher) untimed.
+        for _ in range(4):
+            one("high")
+        with lock:
+            results.clear()
+
+        pool = ThreadPoolExecutor(max_workers=64,
+                                  thread_name_prefix="bench-client")
+        t_start = time.perf_counter()
+        t = 0.0
+        sent = 0
+        while t < duration_s:
+            if pattern == "bursty":
+                lam = rps * 3.0 if (t % 1.0) < 0.3 else rps / 3.0
+            elif pattern == "diurnal":
+                lam = max(
+                    rps * (1.0 + 0.9 * math.sin(
+                        2.0 * math.pi * t / duration_s)),
+                    1.0,
+                )
+            else:
+                lam = rps
+            t += float(rng.exponential(1.0 / max(lam, 1e-9)))
+            pause = (t_start + t) - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+            priority = "high" if rng.uniform() < high_frac else "low"
+            pool.submit(one, priority)
+            sent += 1
+        pool.shutdown(wait=True)
+        wall = time.perf_counter() - t_start
+        fe_stats = frontend.stats()
+
+        by_class = {}
+        errors = 0
+        for p in ("high", "low"):
+            lat = np.asarray([ms for pr, code, ms in results
+                              if pr == p and code == 200], np.float64)
+            shed = sum(1 for pr, code, _ in results
+                       if pr == p and code == 503)
+            errs = sum(1 for pr, code, _ in results
+                       if pr == p and code not in (200, 503))
+            errors += errs
+            n = max(lat.size + shed + errs, 1)
+            by_class[p] = {
+                "served": int(lat.size),
+                "shed": shed,
+                "errors": errs,
+                "shed_rate": round(shed / n, 4),
+                "p50_ms": (round(float(np.percentile(lat, 50)), 3)
+                           if lat.size else 0.0),
+                "p95_ms": (round(float(np.percentile(lat, 95)), 3)
+                           if lat.size else 0.0),
+                "p99_ms": (round(float(np.percentile(lat, 99)), 3)
+                           if lat.size else 0.0),
+            }
+        return {
+            "metric": "serve_overload",
+            "value": by_class["high"]["p99_ms"],
+            "unit": "ms",
+            "p99_high_ms": by_class["high"]["p99_ms"],
+            "pattern": pattern,
+            "rps": rps,
+            "achieved_rps": round(sent / max(wall, 1e-9), 1),
+            "replicas": int(replicas),
+            "capacity": int(capacity),
+            "high_frac": high_frac,
+            "classes": by_class,
+            "errors": errors,
+            "retries": fe_stats["retries"],
+            "hedges": fe_stats["hedges"],
+            "buckets": list(buckets),
+            "max_wait_ms": max_wait_ms,
+            "duration_s": duration_s,
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "host_id": socket.gethostname(),
+        }
+    finally:
+        if frontend is not None:
+            frontend.stop()
+        for r in fleet:
+            r.stop()
+        shutil.rmtree(export_dir, ignore_errors=True)
+
+
 def measure(batch_size: int, iters: int, compute_dtype: str, fused_n: int,
             with_bf16: bool) -> dict:
     import jax
@@ -618,7 +801,10 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
          step_path: bool = False, prefetch_depths=(0, 2, 4),
          step_path_epochs: int = 3, step_path_steps: int = 8,
          serve: bool = False, serve_duration_s: float = 4.0,
-         serve_buckets=(1, 8, 32), serve_max_wait_ms: float = 3.0):
+         serve_buckets=(1, 8, 32), serve_max_wait_ms: float = 3.0,
+         serve_pattern=None, serve_rps: float = 120.0,
+         serve_replicas: int = 2, serve_high_frac: float = 0.3,
+         serve_capacity: int = 24):
     """``batch_size`` defaults to 512 — the reference's *global* batch
     (4 GPUs x 128), which fits comfortably on one v5e chip; a multi-chip mesh
     would use the per-device 128 of the config instead.
@@ -630,7 +816,11 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
 
     ``serve=True`` switches to the serving load harness: export one
     artifact, drive the micro-batching server closed- and open-loop,
-    report req/s + latency percentiles + bucket occupancy.
+    report req/s + latency percentiles + bucket occupancy.  With
+    ``serve_pattern`` set it becomes the fleet traffic generator
+    (``measure_serve_overload``): bursty/diurnal arrivals + a priority mix
+    against replicas behind the front end, reporting per-class percentiles
+    and shed rate.
     """
     backend = probe_backend()
     reduced = False
@@ -650,8 +840,17 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
                 with_bf16 = False
                 step_path_epochs = min(step_path_epochs, 2)
                 step_path_steps = min(step_path_steps, 6)
-                serve_duration_s = min(serve_duration_s, 3.0)
-        if serve:
+                serve_duration_s = min(serve_duration_s,
+                                       4.0 if serve_pattern else 3.0)
+                serve_rps = min(serve_rps, 80.0)
+        if serve and serve_pattern:
+            result = measure_serve_overload(
+                duration_s=serve_duration_s, buckets=tuple(serve_buckets),
+                max_wait_ms=serve_max_wait_ms, pattern=serve_pattern,
+                rps=serve_rps, replicas=serve_replicas,
+                high_frac=serve_high_frac, capacity=serve_capacity,
+            )
+        elif serve:
             result = measure_serve(
                 duration_s=serve_duration_s, buckets=tuple(serve_buckets),
                 max_wait_ms=serve_max_wait_ms,
@@ -668,11 +867,13 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
             result["reduced_cpu_fallback"] = True
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
         result = {
-            "metric": ("serve_throughput" if serve
+            "metric": ("serve_overload" if serve and serve_pattern
+                       else "serve_throughput" if serve
                        else "step_path_prefetch" if step_path
                        else "train_step_throughput"),
             "value": 0.0,
-            "unit": "req/s" if serve else "img/s",
+            "unit": ("ms" if serve and serve_pattern
+                     else "req/s" if serve else "img/s"),
             "vs_baseline": 0.0,
             "backend": backend,
             "error": f"{type(e).__name__}: {e}",
@@ -713,6 +914,19 @@ if __name__ == "__main__":
                    help="comma-separated batch buckets for --serve")
     p.add_argument("--serve_max_wait_ms", type=float, default=3.0,
                    help="micro-batch max-wait deadline for --serve")
+    p.add_argument("--serve_pattern", default=None,
+                   choices=["steady", "bursty", "diurnal"],
+                   help="with --serve: run the fleet traffic generator "
+                   "with this arrival pattern instead of the single-server "
+                   "closed/open loops")
+    p.add_argument("--serve_rps", type=float, default=120.0,
+                   help="base arrival rate for --serve_pattern")
+    p.add_argument("--serve_replicas", type=int, default=2,
+                   help="in-process replicas behind the front end")
+    p.add_argument("--serve_high_frac", type=float, default=0.3,
+                   help="fraction of requests sent high-priority")
+    p.add_argument("--serve_capacity", type=int, default=24,
+                   help="front-end in-flight admission capacity")
     a = p.parse_args()
     main(a.batch_size, a.iters, a.compute_dtype, a.fused_n, not a.no_bf16,
          a.cpu_full, a.step_path,
@@ -720,4 +934,5 @@ if __name__ == "__main__":
          a.step_path_epochs, a.step_path_steps,
          a.serve, a.serve_duration_s,
          tuple(int(b) for b in a.serve_buckets.split(",")),
-         a.serve_max_wait_ms)
+         a.serve_max_wait_ms, a.serve_pattern, a.serve_rps,
+         a.serve_replicas, a.serve_high_frac, a.serve_capacity)

@@ -22,18 +22,33 @@ Spec grammar (comma-separated clauses)::
     save_ioerror@task0         transient OSError on task 0's checkpoint save
     swap_ioerror@task1         the serving hot-swap TO task 1's artifact fails
     slow_swap@task1            that swap stalls for slow_s before loading
+    replica_die@task0          serving replica 0 SIGKILLs itself on a request
+    slow_replica@task1         replica 1 stalls one request for slow_s
+    frontend_ioerror@task2     the front end's dispatch to replica 2 errors
 
 Coordinates use the run-log numbering: ``task`` is the 0-based ``task_id``,
-``epoch``/``step`` are 1-based like the ``epoch`` records.  Unspecified
-coordinates are wildcards (``kill@task1`` fires at the end of task 1's first
-epoch); a kill/raise clause without a ``step`` coordinate never fires at the
-per-step site — mid-epoch would strike before the named epoch's checkpoint
-exists.  Engine coordinates fire at the *end* of the named unit —
-after the epoch's checkpoint hook, after the step's dispatch — so a kill at
-``task1.epoch3`` leaves the epoch-3 checkpoint on disk and the resumed twin
-replays from exactly there.  ``step``/``producer``-level sites exist only on
-the per-batch path (``--no_fused_epochs``); the fused epoch is one opaque
-device program.
+``epoch``/``step`` are 1-based like the ``epoch`` records.  The serving-fleet
+sites (``serve.replica``, ``serve.frontend``) reuse the ``task`` coordinate
+as the *replica id* — the grammar stays one-dimensional and the ledger
+semantics (one-shot, durable across a replica relaunch) carry over
+unchanged.  Unspecified coordinates are wildcards (``kill@task1`` fires at
+the end of task 1's first epoch); a kill/raise clause without a ``step``
+coordinate never fires at the per-step site — mid-epoch would strike before
+the named epoch's checkpoint exists.  Engine coordinates fire at the *end*
+of the named unit — after the epoch's checkpoint hook, after the step's
+dispatch — so a kill at ``task1.epoch3`` leaves the epoch-3 checkpoint on
+disk and the resumed twin replays from exactly there.
+
+``step``-level clauses fire on both execution paths: live at the per-batch
+``engine.step`` site (``--no_fused_epochs``), and under fused epochs —
+where the whole epoch is one opaque device program and no host code runs
+between steps — via end-of-epoch *reconciliation* (:meth:`reconcile_steps`):
+once the fused program returns and the host knows how many steps ran, every
+armed step clause inside that epoch fires in step order, marked
+``reconciled`` in the ledger and telemetry.  The observable timing shifts to
+the epoch boundary (before the epoch-checkpoint hook), but the clause
+fires exactly once either way.  ``data.produce`` remains per-batch-only:
+there is no producer thread inside a fused program.
 
 Each clause fires **once**.  With a ledger path (defaulted to
 ``<ckpt_dir>/fault_ledger.jsonl`` by the trainer), the firing is recorded
@@ -69,6 +84,10 @@ from typing import Dict, List, Optional, Tuple
 #                                              coords: task[, epoch]
 #   serve.swap     serving/server.py, before the watcher applies a manifest
 #                  hot-swap                    coords: task (the swap TARGET)
+#   serve.replica  serving/replica.py, before a replica handles a /predict
+#                  request                     coords: task (= replica id)
+#   serve.frontend serving/frontend.py, before the front end dispatches to a
+#                  replica                     coords: task (= replica id)
 ACTIONS: Dict[str, frozenset] = {
     "kill": frozenset({"engine.epoch", "engine.step"}),
     "raise": frozenset({"engine.epoch", "engine.step"}),
@@ -79,13 +98,18 @@ ACTIONS: Dict[str, frozenset] = {
     "save_ioerror": frozenset({"ckpt.save"}),
     "swap_ioerror": frozenset({"serve.swap"}),
     "slow_swap": frozenset({"serve.swap"}),
+    "replica_die": frozenset({"serve.replica"}),
+    "slow_replica": frozenset({"serve.replica"}),
+    "frontend_ioerror": frozenset({"serve.frontend"}),
 }
 
 # Actions fire() performs itself vs. actions the call site must apply (a
 # checkpoint file can only be corrupted by the code that knows its path;
-# a swap can only be failed by the server that owns the swap).
+# a swap can only be failed by the server that owns the swap; a dispatch can
+# only be failed by the front end that owns the connection).
 COOPERATIVE = frozenset({
     "corrupt_ckpt", "truncate_ckpt", "save_ioerror", "swap_ioerror",
+    "frontend_ioerror",
 })
 
 # step nests inside epoch (a step coordinate without its epoch is ambiguous
@@ -220,44 +244,89 @@ class FaultInjector:
         for clause in matched:
             self._armed.remove(clause)
             self._record(clause, site, coords)
-            if clause.action == "kill":
-                if self.on_fatal is not None:
-                    try:
-                        self.on_fatal()
-                    except Exception:  # jaxlint: disable=JL302
-                        pass  # forensics must never block the injected death
-                os.kill(os.getpid(), signal.SIGKILL)
-            elif clause.action in ("raise", "producer_die"):
-                raise FaultInjected(clause, site, coords)
-            elif clause.action in ("slow_batch", "slow_swap"):
-                time.sleep(self.slow_s)
-            else:
-                cooperative.append(clause.action)
+            self._execute(clause, site, coords, cooperative)
+        return tuple(cooperative)
+
+    def reconcile_steps(
+        self, site: str, task: int, epoch: int, steps: int
+    ) -> Tuple[str, ...]:
+        """End-of-epoch step reconciliation for the fused-epoch path.
+
+        The fused program runs the whole epoch on-device, so the per-step
+        ``fire`` sites never execute; once it returns, the host knows how
+        many steps ran and settles the bill: every armed step-level clause
+        matching ``site``/``task``/``epoch`` with ``step <= steps`` fires
+        now, in step order, tagged ``reconciled`` in the ledger and the
+        ``fault_injected`` record.  Clauses aimed past the epoch's end stay
+        armed.  Same one-shot/ledger/action semantics as :meth:`fire`.
+        """
+        if not self._armed:
+            return ()
+        matched = sorted(
+            (c for c in self._armed
+             if c.step is not None and c.step <= steps
+             and c.matches(site, {"task": task, "epoch": epoch,
+                                  "step": c.step})),
+            key=lambda c: c.step,
+        )
+        cooperative: List[str] = []
+        for clause in matched:
+            coords = {"task": task, "epoch": epoch, "step": clause.step}
+            self._armed.remove(clause)
+            self._record(clause, site, coords, reconciled=True)
+            self._execute(clause, site, coords, cooperative)
         return tuple(cooperative)
 
     # ------------------------------------------------------------------ #
 
-    def _record(self, clause: FaultClause, site: str, coords: dict) -> None:
+    def _execute(
+        self, clause: FaultClause, site: str, coords: dict,
+        cooperative: List[str],
+    ) -> None:
+        if clause.action in ("kill", "replica_die"):
+            if self.on_fatal is not None:
+                try:
+                    self.on_fatal()
+                except Exception:  # jaxlint: disable=JL302
+                    pass  # forensics must never block the injected death
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif clause.action in ("raise", "producer_die"):
+            raise FaultInjected(clause, site, coords)
+        elif clause.action in ("slow_batch", "slow_swap", "slow_replica"):
+            time.sleep(self.slow_s)
+        else:
+            cooperative.append(clause.action)
+
+    def _record(
+        self, clause: FaultClause, site: str, coords: dict,
+        reconciled: bool = False,
+    ) -> None:
         # Ledger strictly before the action: a SIGKILL between the two writes
         # must lose the telemetry record, never the disarm.
         if self.ledger_path:
             os.makedirs(
                 os.path.dirname(os.path.abspath(self.ledger_path)), exist_ok=True
             )
+            entry = {
+                "spec": clause.spec, "site": site,
+                "ts": round(time.time(), 3), "pid": os.getpid(), **coords,
+            }
+            if reconciled:
+                entry["reconciled"] = True
             with open(self.ledger_path, "a") as f:
-                f.write(json.dumps({
-                    "spec": clause.spec, "site": site,
-                    "ts": round(time.time(), 3), "pid": os.getpid(), **coords,
-                }) + "\n")
+                f.write(json.dumps(entry) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
         if self.sink is not None:
+            extra = {"reconciled": True} if reconciled else {}
             self.sink.log(
                 "fault_injected", site=site, action=clause.action,
                 spec=clause.spec,
                 **{k: v for k, v in coords.items() if v is not None},
+                **extra,
             )
-        print(f"| FAULT INJECTED: {clause.spec} at {site} {coords}")
+        print(f"| FAULT INJECTED: {clause.spec} at {site} {coords}"
+              + (" (reconciled)" if reconciled else ""))
 
     def _load_ledger(self) -> Dict[str, int]:
         spent: Dict[str, int] = {}

@@ -37,9 +37,11 @@ SCHEMA = {
         None,
     ),
     # Fault injection (faults/injector.py): one record per fired clause.
+    # reconciled=True marks a step-level clause settled at the fused-epoch
+    # boundary (reconcile_steps) rather than live at the per-batch site.
     "fault_injected": (
         {"site": str, "action": str, "spec": str},
-        {"task": NUM, "epoch": NUM, "step": NUM},
+        {"task": NUM, "epoch": NUM, "step": NUM, "reconciled": bool},
         None,
     ),
     # ThreadCheck sentinel (analysis/threadcheck.py, --check_threads): a
@@ -220,6 +222,39 @@ SCHEMA = {
          "n": NUM},
         {"train_acc_per_task": (list, type(None)),
          "skew_abs_max": (int, float, type(None))},
+        None,
+    ),
+    # Front-end admission control (serving/frontend.py): a request was
+    # rejected at admission.  Rate-limited (~2/s per class) with shed_total
+    # carrying the cumulative count, so overload does not amplify itself
+    # through its own telemetry.
+    "serve_shed": (
+        {"priority": str, "queued": NUM, "capacity": NUM},
+        {"shed_total": NUM},
+        None,
+    ),
+    # Fleet health transitions (serving/health.py): event is "eject" (the
+    # consecutive-error breaker tripped, or the replica's heartbeat went
+    # stale) or "readmit" (the out-of-band warm probe passed).
+    "replica_ejected": (
+        {"replica": NUM, "event": str, "reason": str},
+        {"consecutive_errors": NUM, "heartbeat_age_s": NUM},
+        None,
+    ),
+    # A skew-gated swap was refused and the replica kept (rolled back to)
+    # its previous artifact; emitted by the replica's swap_to and by the
+    # front end's rollout driver when a wave halts.
+    "serve_rollback": (
+        {"task_id": NUM, "rolled_back_to": (int, float, type(None)),
+         "reason": str},
+        {"replica": NUM, "probe_max_abs": NUM, "probe_checked": bool},
+        None,
+    ),
+    # One failed dispatch attempt inside a request's failover chain
+    # (serving/frontend.py); the request itself may still succeed.
+    "frontend_retry": (
+        {"replica": NUM, "attempt": NUM, "error": str},
+        {},
         None,
     ),
     # Rolling latency window from the inference server's batcher.

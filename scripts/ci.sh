@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # One-shot CI: static analysis first (jaxlint, then ruff/mypy when they are
 # installed), telemetry-schema lint over the committed evidence logs, a CPU
-# prefetch determinism smoke, the chaos + serving smokes, the perf-regression
-# gates (train step and serving p99), then the tier-1 test suite (the exact
+# prefetch determinism smoke, the chaos + serving smokes (single-server and replicated
+# fleet), the perf-regression gates (train step, serving p99, and fleet p99
+# under overload), then the tier-1 test suite (the exact
 # ROADMAP.md command).  Run from anywhere:
 #
 #   bash scripts/ci.sh
@@ -11,14 +12,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/10: jaxlint (JAX-hazard + lock-discipline static analysis) =="
+echo "== stage 1/12: jaxlint (JAX-hazard + lock-discipline static analysis) =="
 # Fails on any finding not in analysis/jaxlint_baseline.json, and
 # (--check-baseline) on any baseline entry that no longer matches a live
 # finding — suppressions must not rot.  After fixing or justifying
 # findings, refresh with: python scripts/jaxlint.py --write-baseline
 python scripts/jaxlint.py --check-baseline || exit 1
 
-echo "== stage 2/10: ruff + mypy (skipped when not installed) =="
+echo "== stage 2/12: ruff + mypy (skipped when not installed) =="
 # Configured in pyproject.toml; the container does not bake these in, so the
 # stage gates on availability instead of failing the whole run.
 if command -v ruff >/dev/null 2>&1; then
@@ -32,16 +33,16 @@ else
   echo "mypy not installed; skipping"
 fi
 
-echo "== stage 3/10: telemetry schema lint =="
+echo "== stage 3/12: telemetry schema lint =="
 python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
 
-echo "== stage 4/10: CPU prefetch smoke (depth 2 ≡ depth 0) =="
+echo "== stage 4/12: CPU prefetch smoke (depth 2 ≡ depth 0) =="
 # Two-task synthetic run on the per-batch step path at --prefetch_depth 2;
 # its accuracy matrix must match a depth-0 run exactly (the asynchronous
 # input pipeline's determinism guarantee, data/prefetch.py).
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/prefetch_smoke.py || exit 1
 
-echo "== stage 5/10: jaxlint self-test fixtures =="
+echo "== stage 5/12: jaxlint self-test fixtures =="
 # The linter must still *find* the hazards it exists for (incl. the PR 3
 # restore-aliasing regression); covered by tests/test_jaxlint.py in tier-1,
 # but a broken linter that silently passes everything would also pass stage 1,
@@ -77,7 +78,7 @@ with tempfile.TemporaryDirectory() as d:
 print("jaxlint flags the restore-aliasing fixture: OK")
 PY
 
-echo "== stage 6/10: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
+echo "== stage 6/12: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # A tiny synthetic run SIGKILLs itself mid-task (--fault_spec kill@task1.epoch2),
 # scripts/supervise.py relaunches it with --resume, and the completed run's
 # accuracy matrix must be bit-identical to its fault-free twin — the
@@ -87,7 +88,7 @@ echo "== stage 6/10: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # thread_violation records (analysis/threadcheck.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 
-echo "== stage 7/10: CPU serve smoke (export + hot-swap under fire) =="
+echo "== stage 7/12: CPU serve smoke (export + hot-swap under fire) =="
 # Train a tiny 2-task run with --export_dir, then serve the artifacts under
 # live traffic while hot-swapping task 0 -> 1 with an injected swap_ioerror:
 # the failed swap must degrade gracefully (keep serving task 0, emit
@@ -98,18 +99,36 @@ echo "== stage 7/10: CPU serve smoke (export + hot-swap under fire) =="
 # ThreadCheck sentinel and must emit zero thread_violation records.
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || exit 1
 
-echo "== stage 8/10: perf regression gate (bench.py vs BASELINE.json) =="
+echo "== stage 8/12: perf regression gate (bench.py vs BASELINE.json) =="
 # step_ms is hard-gated at +15% vs the committed bench_gate entry;
 # fetch_overhead_ms loosely (see scripts/perf_gate.py).  After a deliberate
 # perf change, refresh with: python scripts/perf_gate.py --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py || exit 1
 
-echo "== stage 9/10: serving perf gate (bench.py --serve vs BASELINE.json) =="
+echo "== stage 9/12: serving perf gate (bench.py --serve vs BASELINE.json) =="
 # Closed-loop p99 latency of the micro-batching server, gated at +15% vs
 # the serve_gate entry.  Refresh: python scripts/perf_gate.py --serve --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve || exit 1
 
-echo "== stage 10/10: tier-1 tests =="
+echo "== stage 10/12: fleet overload soak (replicas + SIGKILL + rolling swap) =="
+# The resilience-tier chaos smoke: three supervised replica subprocesses
+# behind the admission-controlled front end under live bursty two-priority
+# traffic.  One replica is SIGKILL'd mid-traffic (breaker eject -> supervised
+# relaunch -> warm-probe readmit) and a rolling swap hits one injected
+# swap_ioerror (rollback on that replica only, wave halts, retry converges).
+# Zero failed client requests; sheds/rollbacks/ejections must appear as
+# schema-valid records; everything runs under --check_threads
+# (serving/frontend.py, serving/replica.py, serving/health.py).
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py --fleet || exit 1
+
+echo "== stage 11/12: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
+# High-priority p99 under bursty overload through the replicated front end,
+# gated at +15% vs the serve_overload_gate entry: shedding low-priority work
+# exists precisely to keep this number flat.  Refresh:
+# python scripts/perf_gate.py --serve-overload --update-baseline
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve-overload || exit 1
+
+echo "== stage 12/12: tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

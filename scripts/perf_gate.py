@@ -31,6 +31,15 @@ across dispatch) shows up there long before mean throughput moves.  A
 baseline from a different backend, bucket set, or max-wait is incomparable
 and SKIPs, same rule as the train gate.
 
+``--serve-overload`` gates the fleet under overload: ``bench.py --serve
+--serve_pattern bursty`` drives a replicated front end (admission control +
+priority shedding) with bursty open-loop arrivals and gates the
+*high-priority* p99 against the ``serve_overload_gate`` entry.  The point of
+shedding low-priority work is that the high class's tail stays flat through
+the burst — a regression here means the shed policy, breaker, or hedging
+changed behaviour.  Any hard request error fails outright; sheds are the
+mechanism under test, not a failure.
+
 Exit 0 on pass/skip, 1 on fail, one JSON verdict line either way.
 """
 
@@ -161,6 +170,48 @@ def gate_serve(result: dict, baseline: dict) -> dict:
     return {"status": "fail" if reasons else "pass", "reasons": reasons}
 
 
+def gate_serve_overload(result: dict, baseline: dict) -> dict:
+    """Overload gate: high-priority p99 vs the ``serve_overload_gate`` entry."""
+    if result.get("error") or not result.get("value"):
+        return {"status": "fail",
+                "reasons": [f"overload bench did not produce a valid "
+                            f"measurement: {result.get('error', 'value=0')}"]}
+    if result.get("errors"):
+        # Hard errors under overload are a resilience bug (shedding exists
+        # precisely so overload degrades to 503s, never to failures).
+        return {"status": "fail",
+                "reasons": [f"{result['errors']} request(s) hard-failed "
+                            "during the overload bench"]}
+    for key in ("backend", "replicas", "pattern", "rps"):
+        if baseline.get(key) is not None and result.get(key) != baseline[key]:
+            return {"status": "skip",
+                    "reasons": [f"incomparable {key}: baseline "
+                                f"{baseline[key]!r} vs measured "
+                                f"{result.get(key)!r} — refresh the baseline "
+                                "on this machine (--serve-overload "
+                                "--update-baseline)"]}
+    tol = baseline.get("tolerance", SERVE_TOLERANCE)
+    base_p99 = baseline.get("p99_high_ms")
+    p99 = result.get("p99_high_ms")
+    if base_p99 is None or p99 is None:
+        return {"status": "skip",
+                "reasons": ["no p99_high_ms to compare (baseline entry "
+                            "missing — record one with --serve-overload "
+                            "--update-baseline)"]}
+    reasons = []
+    limit = base_p99 * (1.0 + tol)
+    if p99 > limit:
+        reasons.append(
+            f"overload p99_high_ms regressed: {p99:.1f} > {limit:.1f} "
+            f"(baseline {base_p99:.1f} + {tol:.0%})")
+    if not reasons and p99 < base_p99 * (1.0 - tol):
+        reasons.append(
+            f"note: overload p99_high_ms improved {base_p99:.1f} -> "
+            f"{p99:.1f}; refresh the baseline to tighten the gate")
+        return {"status": "pass", "reasons": reasons}
+    return {"status": "fail" if reasons else "pass", "reasons": reasons}
+
+
 def load_baseline(path: str = _BASELINE) -> dict:
     try:
         with open(path) as f:
@@ -170,9 +221,21 @@ def load_baseline(path: str = _BASELINE) -> dict:
 
 
 def update_baseline(result: dict, path: str = _BASELINE,
-                    serve: bool = False) -> dict:
+                    serve: bool = False, overload: bool = False) -> dict:
     doc = load_baseline(path)
-    if serve:
+    if overload:
+        entry = {
+            "p99_high_ms": result.get("p99_high_ms"),
+            "backend": result.get("backend"),
+            "replicas": result.get("replicas"),
+            "pattern": result.get("pattern"),
+            "rps": result.get("rps"),
+            "capacity": result.get("capacity"),
+            "tolerance": SERVE_TOLERANCE,
+            "recorded_ts": round(time.time(), 3),
+        }
+        doc["serve_overload_gate"] = entry
+    elif serve:
         entry = {
             "p99_ms": result.get("p99_ms"),
             "p50_ms": result.get("p50_ms"),
@@ -209,6 +272,9 @@ def main(argv=None) -> int:
     p.add_argument("--serve", action="store_true",
                    help="gate the serving bench (bench.py --serve) against "
                    "the serve_gate entry instead of the train step")
+    p.add_argument("--serve-overload", action="store_true",
+                   help="gate the fleet overload bench (bench.py --serve "
+                   "--serve_pattern bursty) against serve_overload_gate")
     p.add_argument("--result", default=None,
                    help="gate this JSON result instead of running bench.py "
                    "(tests / canned measurements)")
@@ -216,17 +282,31 @@ def main(argv=None) -> int:
                    help="path to BASELINE.json")
     args = p.parse_args(argv)
 
-    extra = ("--serve",) if args.serve else ()
+    if args.serve_overload:
+        # Fixed args so the recorded baseline stays comparable run to run.
+        extra = ("--serve", "--serve_pattern", "bursty", "--serve_rps", "40",
+                 "--serve_duration_s", "3", "--serve_buckets", "1,8")
+        entry_key = "serve_overload_gate"
+    elif args.serve:
+        extra = ("--serve",)
+        entry_key = "serve_gate"
+    else:
+        extra = ()
+        entry_key = "bench_gate"
     result = (json.loads(args.result) if args.result
               else run_bench(extra_args=extra))
-    entry_key = "serve_gate" if args.serve else "bench_gate"
     if args.update_baseline:
-        entry = update_baseline(result, args.baseline, serve=args.serve)
+        entry = update_baseline(result, args.baseline, serve=args.serve,
+                                overload=args.serve_overload)
         print(json.dumps({"metric": "perf_gate", "status": "updated",
                           entry_key: entry}))
         return 0 if not result.get("error") else 1
     baseline = load_baseline(args.baseline).get(entry_key, {})
-    if args.serve:
+    if args.serve_overload:
+        verdict = gate_serve_overload(result, baseline)
+        measured_keys = ("p99_high_ms", "value", "errors", "backend",
+                         "replicas", "pattern", "rps", "capacity")
+    elif args.serve:
         verdict = gate_serve(result, baseline)
         measured_keys = ("p99_ms", "p50_ms", "value", "failed", "backend",
                          "buckets", "max_wait_ms")

@@ -162,7 +162,9 @@ def render_serve(by_type):
     swaps = by_type["serve_swap"] + by_type["serve_swap_failed"]
     latency = by_type["serve_latency"]
     skew = by_type["serve_skew"]
-    if not (exports or swaps or latency or skew):
+    fleet = (by_type["serve_shed"] + by_type["replica_ejected"]
+             + by_type["serve_rollback"] + by_type["frontend_retry"])
+    if not (exports or swaps or latency or skew or fleet):
         return
     print("## serving\n")
     if exports:
@@ -209,6 +211,44 @@ def render_serve(by_type):
                 f"{rec.get('throughput_rps', 0):.1f} | "
                 f"{rec.get('bucket_occupancy', 0):.3f} |"
             )
+        print()
+    if fleet:
+        # The front end's availability story in one ts-ordered timeline:
+        # sheds (admission policy), retries (failover), eject/readmit
+        # cycles (breaker + relaunch), rollbacks (skew-gated swaps).
+        ejections = by_type["replica_ejected"]
+        rollbacks = by_type["serve_rollback"]
+        retries = by_type["frontend_retry"]
+        shed_total = sum(
+            s.get("shed_total", 1) for s in by_type["serve_shed"][-1:]) or len(
+            by_type["serve_shed"])
+        print(
+            f"fleet health: {len(ejections)} eject/readmit event(s), "
+            f"{len(retries)} failover retry(ies), "
+            f"{len(rollbacks)} rollback(s), ~{shed_total} shed(s)\n")
+        print("| ts | event | detail |")
+        print("|---|---|---|")
+        for rec in sorted(fleet, key=lambda r: r.get("ts", 0)):
+            kind = rec.get("type")
+            if kind == "serve_shed":
+                detail = (f"priority={rec.get('priority')} "
+                          f"queued={rec.get('queued')}/"
+                          f"{rec.get('capacity')} "
+                          f"total={rec.get('shed_total', '—')}")
+            elif kind == "replica_ejected":
+                kind = f"replica {rec.get('replica')} {rec.get('event')}"
+                detail = rec.get("reason", "—")
+            elif kind == "serve_rollback":
+                kind = "**ROLLBACK**"
+                detail = (f"replica={rec.get('replica', '—')} "
+                          f"task {rec.get('task_id')} -> "
+                          f"{rec.get('rolled_back_to')} "
+                          f"({rec.get('reason', '?')})")
+            else:
+                detail = (f"replica={rec.get('replica')} "
+                          f"attempt={rec.get('attempt')} "
+                          f"{rec.get('error', '')}")
+            print(f"| {rec.get('ts', '?')} | {kind} | {detail} |")
         print()
     if skew:
         print("training/serving skew (served artifact vs training row):\n")

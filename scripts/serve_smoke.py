@@ -22,15 +22,32 @@ The acceptance proof for the serving subsystem, end to end:
    queries only ever run AOT-compiled executables) and that every telemetry
    file the run produced passes the schema lint.
 
+``--fleet`` runs the resilience-tier chaos smoke instead: three supervised
+replica subprocesses (``scripts/supervise.py`` relaunch machinery) behind an
+in-process ``serving.Frontend`` under live bursty two-priority traffic.
+Mid-traffic, one replica is SIGKILL'd (ejected by the breaker, relaunched by
+its supervisor, re-admitted after the warm-up probe) and a new task is
+published with ``swap_ioerror@task1`` armed on one replica: the rolling swap
+must roll back on that replica only (``serve_rollback``), halt the wave, and
+converge on the retry.  The acceptance bar: ZERO failed client requests
+(503 sheds are the admission policy working, not failures), at least one
+``serve_shed`` and one ``serve_rollback`` record, an eject/readmit cycle for
+the killed replica, every replica finishing on the new task with
+``trace_count() == 0``, zero ThreadCheck violations, and schema-clean
+telemetry throughout.
+
 Exit 0 when all of it holds, 1 otherwise, one JSON line either way.
 Used by ``scripts/ci.sh``; runnable standalone from anywhere.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import shutil
+import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -277,5 +294,378 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
         return 0 if not failures else 1
 
 
+# --------------------------------------------------------------------- #
+# Fleet chaos smoke (--fleet)
+# --------------------------------------------------------------------- #
+
+
+def _free_ports(n):
+    """Pick n distinct free ports (bind-then-close; replicas rebind them)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _get_json(port, path, timeout=3.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
+    failures = []
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (  # noqa: E501
+        force_platform,
+    )
+
+    cache_dir = os.path.join(_REPO, "tests", ".jax_cache")
+    force_platform("cpu", compile_cache_dir=cache_dir)
+    import jax
+    import numpy as np
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.augment import (  # noqa: E501
+        AugmentConfig,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+        grow,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (  # noqa: E501
+        JsonlLogger,
+    )
+    from serving import Frontend, register_artifact
+    from serving.artifact import export_artifact
+    from serving.replica import decode_logits, encode_image, supervised_replica_cmd
+
+    N = 3
+    FAULT_REPLICA = 0  # refuses its first swap to task 1 (swap_ioerror)
+    KILL_REPLICA = 2   # SIGKILL'd mid-traffic; supervisor relaunches it
+
+    with tempfile.TemporaryDirectory(prefix="serve_fleet_") as tmp:
+        # Two artifacts exported in-process (the train->export path is the
+        # single-server smoke's job; this one is about the fleet).
+        export_dir = os.path.join(tmp, "export")
+        os.makedirs(export_dir)
+
+        def _export(task_id, known, seed):
+            model, variables = create_model("resnet20", 10)
+            variables = grow(variables, jax.random.PRNGKey(seed), 0, known)
+            export_artifact(
+                export_dir, task_id, model, AugmentConfig(),
+                variables["params"], variables["batch_stats"],
+                known=known, class_order=list(range(10)),
+                input_size=32, channels=3, buckets=(1, 8),
+                model_meta={"backbone": "resnet20", "width": 10,
+                            "compute_dtype": "float32", "bn_group_size": 0},
+            )
+
+        _export(0, 5, 0)
+        _export(1, 10, 1)
+
+        # The shared serving store starts with task 0 only; task 1 is
+        # published mid-traffic to trigger the rolling swap.
+        serve_dir = os.path.join(tmp, "serve")
+        os.makedirs(serve_dir)
+        shutil.copytree(os.path.join(export_dir, "task_000"),
+                        os.path.join(serve_dir, "task_000"))
+        register_artifact(serve_dir, 0, {"path": "task_000"})
+
+        tdir = os.path.join(tmp, "telemetry")
+        ports = _free_ports(N)
+        procs, consoles = [], []
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   JAX_COMPILATION_CACHE_DIR=cache_dir)
+        try:
+            for i in range(N):
+                rdir = os.path.join(tdir, f"replica_{i}")
+                os.makedirs(rdir, exist_ok=True)
+                cmd = supervised_replica_cmd(
+                    _REPO, serve_dir, i, ports[i], tdir,
+                    fault_spec=("swap_ioerror@task1" if i == FAULT_REPLICA
+                                else None),
+                    check_threads=True,
+                )
+                console = open(os.path.join(rdir, "console.log"), "wb")
+                consoles.append(console)
+                procs.append(subprocess.Popen(
+                    cmd, cwd=_REPO, env=env, start_new_session=True,
+                    stdout=console, stderr=subprocess.STDOUT,
+                ))
+
+            # Fleet warm-up: every replica must answer /healthz warm before
+            # traffic starts (cold replicas would read as chaos, not serve it).
+            warm = set()
+            deadline = time.time() + 300
+            while time.time() < deadline and len(warm) < N:
+                for i in range(N):
+                    if i in warm:
+                        continue
+                    try:
+                        st, info = _get_json(ports[i], "/healthz")
+                        if st == 200 and info.get("warm"):
+                            warm.add(i)
+                    except (OSError, ValueError):
+                        pass
+                time.sleep(0.5)
+            if len(warm) < N:
+                print(json.dumps({
+                    "metric": "serve_fleet_smoke", "ok": False,
+                    "failures": [f"replicas never warmed: {sorted(warm)}"]}))
+                return 1
+            st, info = _get_json(ports[KILL_REPLICA], "/healthz")
+            victim_pid = info["pid"]
+
+            # Everything from here runs under the ThreadCheck sentinel: the
+            # front end's locks are created post-install, so any lock held
+            # across a socket read / future wait in the routing, breaker,
+            # hedging or rollout paths emits thread_violation.
+            from analysis import threadcheck
+
+            check = threadcheck.install()
+            fe_log = os.path.join(tmp, "frontend.jsonl")
+            sink = JsonlLogger(fe_log)
+            check.bind_sink(sink)
+            fe = Frontend(
+                [("127.0.0.1", p) for p in ports],
+                capacity=6, low_watermark=2,       # tight: bursts must shed
+                default_deadline_ms=15000.0,
+                max_attempts=5, retry_backoff_s=0.02,
+                hedge_ms=250.0,
+                error_threshold=3,
+                heartbeat_max_age_s=8.0,
+                heartbeat_paths=[
+                    os.path.join(tdir, f"replica_{i}", "heartbeat.json")
+                    for i in range(N)],
+                probe_s=0.5,
+                export_dir=serve_dir, rollout_poll_s=1.0,
+                sink=sink,
+            ).start()
+
+            results = {"high": [], "low": []}
+            sheds = {"high": 0, "low": 0}
+            hard_failures = []
+            first_payload = []
+            res_lock = threading.Lock()
+            stop_traffic = threading.Event()
+            body = encode_image(np.random.RandomState(0).randint(
+                0, 256, (32, 32, 3)).astype(np.uint8))
+
+            def client(priority):
+                while not stop_traffic.is_set():
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", fe.port, timeout=30.0)
+                    try:
+                        conn.request("POST", "/predict", body=body, headers={
+                            "Content-Type": "application/octet-stream",
+                            "X-Priority": priority,
+                            "X-Deadline-Ms": "15000",
+                        })
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        with res_lock:
+                            if resp.status == 200:
+                                results[priority].append(
+                                    int(resp.getheader("X-Task-Id")))
+                                if not first_payload:
+                                    first_payload.append(payload)
+                            elif resp.status == 503:
+                                # A shed is the admission policy doing its
+                                # job under overload — never a failure.
+                                sheds[priority] += 1
+                            else:
+                                hard_failures.append(
+                                    (priority, resp.status,
+                                     payload[:120].decode("ascii", "replace")))
+                    except Exception as e:  # noqa: BLE001 — asserted == 0
+                        with res_lock:
+                            hard_failures.append((priority, "exc", repr(e)))
+                    finally:
+                        conn.close()
+                    if priority == "high":
+                        time.sleep(0.01)
+
+            clients = ([threading.Thread(target=client, args=("high",))
+                        for _ in range(2)]
+                       + [threading.Thread(target=client, args=("low",))
+                          for _ in range(8)])
+            for t in clients:
+                t.start()
+            converged_tasks = {}
+            try:
+                time.sleep(2.0)  # steady traffic against task 0
+
+                # Chaos, act 1: SIGKILL one replica under live traffic.  The
+                # breaker must eject it, the supervisor must relaunch it on
+                # the same port, and the warm probe must re-admit it.
+                os.kill(victim_pid, signal.SIGKILL)
+                deadline = time.time() + 60
+                while (time.time() < deadline
+                       and KILL_REPLICA not in fe.health.ejected()):
+                    time.sleep(0.2)
+                if KILL_REPLICA not in fe.health.ejected():
+                    failures.append("killed replica was never ejected")
+                deadline = time.time() + 240
+                while (time.time() < deadline
+                       and not fe.health.is_healthy(KILL_REPLICA)):
+                    time.sleep(0.5)
+                if not fe.health.is_healthy(KILL_REPLICA):
+                    failures.append("killed replica was never re-admitted")
+
+                # Chaos, act 2: publish task 1.  The rollout wave must roll
+                # back on FAULT_REPLICA (injected swap_ioerror), halt, then
+                # converge on the retry once the one-shot clause is spent.
+                shutil.copytree(os.path.join(export_dir, "task_001"),
+                                os.path.join(serve_dir, "task_001"))
+                register_artifact(serve_dir, 1, {"path": "task_001"})
+                deadline = time.time() + 180
+                while time.time() < deadline:
+                    for i in range(N):
+                        try:
+                            st, info = _get_json(ports[i], "/healthz")
+                            converged_tasks[i] = info.get("task_id")
+                        except (OSError, ValueError):
+                            converged_tasks[i] = None
+                    if all(t == 1 for t in converged_tasks.values()):
+                        break
+                    time.sleep(0.5)
+                if not all(t == 1 for t in converged_tasks.values()):
+                    failures.append(
+                        f"fleet never converged on task 1: {converged_tasks}")
+                time.sleep(1.0)  # post-rollout traffic against task 1
+            finally:
+                stop_traffic.set()
+                for t in clients:
+                    t.join()
+                fe_stats = fe.stats()
+                fe.stop()
+            threadcheck.uninstall()
+
+            # ---------------- assertions ---------------- #
+            if hard_failures:
+                failures.append(
+                    f"{len(hard_failures)} failed client request(s) "
+                    f"(first: {hard_failures[:3]})")
+            if not results["high"] or not results["low"]:
+                failures.append(f"no traffic served: { {p: len(v) for p, v in results.items()} }")  # noqa: E501
+            if first_payload and decode_logits(first_payload[0]).ndim != 1:
+                failures.append("response payload is not a logits row")
+            if sheds["high"] + sheds["low"] == 0:
+                failures.append("overload never shed a request")
+            tasks_seen = sorted(set(results["high"]) | set(results["low"]))
+            if tasks_seen != [0, 1] or (results["high"]
+                                        and results["high"][-1] != 1):
+                failures.append(
+                    f"responses did not transition 0 -> 1: {tasks_seen}")
+
+            st, relaunched = _get_json(ports[KILL_REPLICA], "/healthz")
+            if relaunched.get("pid") == victim_pid:
+                failures.append("killed replica was never relaunched")
+            for i in range(N):
+                if i == KILL_REPLICA:
+                    continue  # survivors: their process lived the whole run
+                st, stats_i = _get_json(ports[i], "/stats")
+                if stats_i.get("trace_count") != 0:
+                    failures.append(
+                        f"survivor replica {i} traced "
+                        f"{stats_i.get('trace_count')} program(s)")
+
+            fe_recs = _records(fe_log)
+            kinds = [r.get("type") for r in fe_recs]
+            if "serve_shed" not in kinds:
+                failures.append(f"no serve_shed record: {sorted(set(kinds))}")
+            rollbacks = [r for r in fe_recs if r.get("type") == "serve_rollback"]
+            if not rollbacks:
+                failures.append("no serve_rollback record")
+            if {r.get("replica") for r in rollbacks} - {FAULT_REPLICA}:
+                failures.append(
+                    f"rollback on an unfaulted replica: {rollbacks}")
+            ejected = [r for r in fe_recs if r.get("type") == "replica_ejected"
+                       and r.get("replica") == KILL_REPLICA]
+            events = [r.get("event") for r in ejected]
+            if "eject" not in events or "readmit" not in events:
+                failures.append(
+                    f"no eject/readmit cycle for replica {KILL_REPLICA}: "
+                    f"{events}")
+            if "frontend_retry" not in kinds:
+                failures.append("SIGKILL under traffic produced no "
+                                "frontend_retry record")
+
+            # Lock discipline: zero violations in this process AND in every
+            # replica subprocess (they all ran --check_threads).
+            replica_logs = [
+                os.path.join(tdir, f"replica_{i}", "run.jsonl")
+                for i in range(N)
+                if os.path.exists(os.path.join(tdir, f"replica_{i}",
+                                               "run.jsonl"))
+            ]
+            tviol = [r for r in fe_recs if r.get("type") == "thread_violation"]
+            for path in replica_logs:
+                tviol += [r for r in _records(path)
+                          if r.get("type") == "thread_violation"]
+            if check.violations or tviol:
+                failures.append(
+                    f"ThreadCheck violations under chaos: "
+                    f"{(check.violations + tviol)[:3]}")
+
+            lint = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "scripts", "check_telemetry_schema.py"),
+                 fe_log, *replica_logs],
+                cwd=_REPO, timeout=120, capture_output=True, text=True)
+            if lint.returncode != 0:
+                failures.append(
+                    f"schema lint failed on fleet telemetry: "
+                    f"{lint.stdout.strip()} {lint.stderr.strip()}")
+
+            print(json.dumps({
+                "metric": "serve_fleet_smoke",
+                "ok": not failures,
+                "failures": failures,
+                "served": fe_stats["served"],
+                "shed": fe_stats["shed"],
+                "client_sheds": sheds,
+                "retries": fe_stats["retries"],
+                "hedges": fe_stats["hedges"],
+                "rollout_swaps": fe_stats["rollout_swaps"],
+                "rollout_rollbacks": fe_stats["rollout_rollbacks"],
+                "converged_tasks": converged_tasks,
+            }))
+            return 0 if not failures else 1
+        finally:
+            for p in procs:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(p.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    p.wait()
+            for console in consoles:
+                console.close()
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the replicated-fleet chaos smoke instead of "
+                    "the single-server train->export->serve smoke")
+    sys.exit(fleet_main() if ap.parse_args().fleet else main())

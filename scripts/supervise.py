@@ -49,11 +49,26 @@ import argparse
 import glob
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
 from typing import List, Optional
+
+
+def backoff_delay(rng: random.Random, base: float, cap: float,
+                  prev: float) -> float:
+    """Decorrelated-jitter restart delay (the AWS exponential-backoff
+    variant): ``min(cap, uniform(base, prev * 3))``.
+
+    Plain doubling relaunches every replica of a fleet killed together at
+    the same instant — a thundering herd against the artifact store and the
+    accelerator allocator.  Jitter decorrelates them while keeping the
+    envelope exponential: the delay never falls below ``base``, never
+    exceeds ``cap``, and grows at most 3x per consecutive failure.
+    """
+    return min(cap, rng.uniform(base, max(base, prev * 3.0)))
 
 
 def _parse_args(argv: List[str]):
@@ -72,9 +87,14 @@ def _parse_args(argv: List[str]):
                    help="seconds after launch before staleness checks start "
                    "(process startup + first heartbeat write)")
     p.add_argument("--backoff_base", type=float, default=1.0,
-                   help="first relaunch delay; doubles per consecutive "
-                   "failure up to --backoff_max")
-    p.add_argument("--backoff_max", type=float, default=300.0)
+                   help="minimum relaunch delay; the decorrelated-jitter "
+                   "envelope grows from here up to --backoff_max")
+    p.add_argument("--backoff_max", type=float, default=300.0,
+                   help="hard cap on any relaunch delay")
+    p.add_argument("--backoff_seed", type=int, default=None,
+                   help="seed for the jitter RNG (deterministic tests); "
+                   "default derives from pid+time so replicas killed "
+                   "together do not relaunch in lockstep")
     p.add_argument("--max_failures", type=int, default=5,
                    help="failures within --failure_window that trip the "
                    "crash-loop breaker (exit 2)")
@@ -112,6 +132,10 @@ class Supervisor:
     def __init__(self, args):
         self.args = args
         self.failures: List[float] = []  # monotonic timestamps, sliding window
+        seed = (args.backoff_seed if args.backoff_seed is not None
+                else os.getpid() ^ int(time.time() * 1000))
+        self._rng = random.Random(seed)
+        self._prev_delay = 0.0  # decorrelated-jitter state
 
     # ------------------------------------------------------------------ #
 
@@ -278,7 +302,6 @@ class Supervisor:
         args = self.args
         cmd = list(args.command)
         attempt = 0
-        consecutive = 0
         while True:
             attempt += 1
             rc, uptime, hung = self._run_once(cmd)
@@ -291,11 +314,10 @@ class Supervisor:
                 # A long-lived child that eventually died is a fresh
                 # incident, not part of a crash loop.
                 self.failures.clear()
-                consecutive = 0
+                self._prev_delay = 0.0
             self.failures.append(now)
             self.failures = [t for t in self.failures
                              if now - t <= args.failure_window]
-            consecutive += 1
             if len(self.failures) > args.max_failures:
                 self._event(
                     "breaker", failures=len(self.failures),
@@ -306,8 +328,9 @@ class Supervisor:
                 return 2
             if args.resume_flag and args.resume_flag not in cmd:
                 cmd = cmd + [args.resume_flag]
-            delay = min(args.backoff_base * (2 ** (consecutive - 1)),
-                        args.backoff_max)
+            delay = backoff_delay(self._rng, args.backoff_base,
+                                  args.backoff_max, self._prev_delay)
+            self._prev_delay = delay
             self._event("relaunch", attempt=attempt + 1,
                         backoff_s=round(delay, 2),
                         failures_in_window=len(self.failures))

@@ -14,7 +14,12 @@ computes dies when ``train.py`` exits.  This package is the inference half:
   artifacts: pad-to-bucket dispatch with a max-wait deadline, and an atomic
   hot swap when a new task's artifact lands in the manifest.
 * :mod:`.skew` — served-model accuracy re-measured through the artifact and
-  compared against the training-side accuracy matrix (``serve_skew``).
+  compared against the training-side accuracy matrix (``serve_skew``), plus
+  the golden-probe replay (``probe_artifact``) that gates fleet swaps.
+* :mod:`.replica` / :mod:`.frontend` / :mod:`.health` — the resilience
+  tier: N supervised replica subprocesses behind a stdlib HTTP front end
+  with admission control, priority shedding, circuit-breaker failover,
+  hedged dispatch and skew-gated rolling swaps with per-replica rollback.
 
 Serving never traces: artifacts are loaded by AOT-compiling the deserialized
 exported programs, so a warm server restart (same artifacts, persistent XLA
@@ -35,5 +40,8 @@ from .artifact import (  # noqa: F401
     rebuild_model,
     register_artifact,
 )
+from .frontend import Frontend  # noqa: F401
+from .health import FleetHealth  # noqa: F401
+from .replica import ReplicaServer, supervised_replica_cmd  # noqa: F401
 from .server import InferenceServer  # noqa: F401
-from .skew import measure_skew  # noqa: F401
+from .skew import measure_skew, probe_artifact  # noqa: F401

@@ -30,6 +30,7 @@ zero-retrace contract enforceable (tests/test_serving.py).
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import shutil
@@ -58,6 +59,7 @@ DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 32, 64)
 _MANIFEST = "manifest.json"
 _WEIGHTS = "weights.pkl"
 _META = "meta.json"
+_PROBE = "probe.npz"
 
 
 def _exported_name(bucket: int) -> str:
@@ -234,6 +236,24 @@ def export_artifact(
         _write_bytes_atomic(os.path.join(tmp_dir, name), exp.serialize())
         exported_files[str(bucket)] = name
 
+    # Golden probe: a deterministic input + this export's own logits for it,
+    # frozen into the artifact.  A post-swap server replays the probe through
+    # the freshly loaded executables and demands exact equality
+    # (serving/skew.py probe_artifact) — the cheap, offline-free skew gate
+    # that decides promote-vs-rollback during rolling fleet swaps.
+    probe_bucket = buckets[0]
+    probe_x = np.random.RandomState(0).randint(
+        0, 256, (probe_bucket, input_size, input_size, channels)
+    ).astype(np.uint8)
+    probe_logits = np.asarray(predict(
+        host_params, host_stats, jnp.asarray(int(known), jnp.int32),
+        jnp.asarray(probe_x),
+    ))
+    buf = io.BytesIO()
+    np.savez(buf, x=probe_x, logits=probe_logits,
+             bucket=np.asarray(probe_bucket))
+    _write_bytes_atomic(os.path.join(tmp_dir, _PROBE), buf.getvalue())
+
     meta = {
         "version": 1,
         "task_id": int(task_id),
@@ -249,7 +269,8 @@ def export_artifact(
         "acc_per_task": (
             [float(a) for a in acc_per_task] if acc_per_task is not None else None
         ),
-        "files": {"weights": _WEIGHTS, "exported": exported_files},
+        "files": {"weights": _WEIGHTS, "exported": exported_files,
+                  "probe": _PROBE},
         "created_ts": round(time.time(), 3),
     }
     meta_tmp = os.path.join(tmp_dir, _META + ".tmp")

@@ -1,0 +1,363 @@
+"""One fleet replica: an ``InferenceServer`` behind a tiny HTTP transport.
+
+The resilience tier runs N of these as *supervised subprocesses*
+(``scripts/supervise.py``) off one shared artifact store, so a replica
+dying — SIGKILL'd by a preemption or the injected ``replica_die`` fault —
+is a routine lifecycle event (Podracer, arXiv:2104.06272): the supervisor
+relaunches it with decorrelated-jitter backoff, it rebinds its fixed port
+(``allow_reuse_address``), warms up, and the front end's probe re-admits
+it.  Each replica beats into its own ``<telemetry_dir>/replica_<i>/``
+heartbeat + flight ring, which is exactly what the front end's staleness
+breaker and the supervisor's hang detection watch.
+
+Transport is stdlib ``http.server`` with a thread per connection; payloads
+are raw ``.npy`` bytes (``encode_image`` / ``decode_logits``), so a client
+needs numpy and nothing else:
+
+* ``POST /predict``  — uint8 image ``.npy`` in, logits ``.npy`` out, with
+  ``X-Task-Id`` / ``X-Latency-Ms`` response headers.  Fires the
+  ``serve.replica`` fault site (``replica_die`` / ``slow_replica``) before
+  touching the queue — the fault strikes the replica, never the client.
+* ``GET /healthz``   — ``{replica, task_id, warm, served, pid}``; ``warm``
+  flips true after the post-start self-inference, and the front end's
+  re-admission probe requires it (a replica that accepts TCP but has not
+  compiled its programs yet would eat real traffic).
+* ``POST /swap``     — ``{"task_id": T}`` → skew-gated ``swap_to`` on the
+  wrapped server; HTTP 409 on rollback so the rollout driver sees the
+  verdict in-band.  Replicas run ``auto_swap=False``: the fleet rolls one
+  replica at a time, a watcher-per-replica racing the rollout would not.
+* ``GET /stats``     — the server's stats dict + ``trace_count``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def encode_image(x) -> bytes:
+    """uint8 image array -> ``.npy`` bytes (the /predict request body)."""
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(x, np.uint8))
+    return buf.getvalue()
+
+
+def decode_logits(body: bytes):
+    """/predict response body -> logits array."""
+    import numpy as np
+
+    return np.load(io.BytesIO(body))
+
+
+class ReplicaServer:
+    """HTTP wrapper around one ``InferenceServer``; serves until stopped."""
+
+    def __init__(
+        self,
+        export_dir: str,
+        replica_id: int,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        max_wait_ms: float = 2.0,
+        telemetry=None,
+        sink=None,
+        faults=None,
+        request_timeout_s: float = 30.0,
+    ):
+        from .server import InferenceServer
+
+        self.replica_id = int(replica_id)
+        self.request_timeout_s = float(request_timeout_s)
+        self._faults = faults
+        self._telemetry = telemetry
+        self._warm = threading.Event()
+        self.server = InferenceServer(
+            export_dir,
+            max_wait_ms=max_wait_ms,
+            telemetry=telemetry,
+            sink=sink,
+            faults=faults,
+            auto_swap=False,
+            replica_id=self.replica_id,
+        )
+        replica = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # One replica serves many short requests; per-request log lines
+            # on stderr would swamp the supervisor's event stream.
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json",
+                       headers: Optional[dict] = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, obj: dict) -> None:
+                self._reply(code, json.dumps(obj).encode())
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply_json(200, replica.healthz())
+                elif self.path == "/stats":
+                    stats = replica.server.stats()
+                    stats["replica"] = replica.replica_id
+                    stats["trace_count"] = replica.server.trace_count()
+                    self._reply_json(200, stats)
+                else:
+                    self._reply_json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path == "/predict":
+                    self._predict()
+                elif self.path == "/swap":
+                    self._swap()
+                else:
+                    self._reply_json(404, {"error": f"no route {self.path}"})
+
+            def _predict(self):
+                body = self._body()
+                try:
+                    # The fault strikes before the queue: replica_die
+                    # SIGKILLs this process (the supervisor relaunches),
+                    # slow_replica stalls just this request.
+                    if replica._faults is not None:
+                        replica._faults.fire(
+                            "serve.replica", task=replica.replica_id
+                        )
+                    x = decode_logits(body)  # same .npy codec both ways
+                    fut = replica.server.submit(x)
+                    res = fut.result(timeout=replica.request_timeout_s)
+                except Exception as e:  # noqa: BLE001 — becomes a 500
+                    self._reply_json(500, {"error": repr(e),
+                                           "replica": replica.replica_id})
+                    return
+                import numpy as np
+
+                out = io.BytesIO()
+                np.save(out, res["logits"])
+                self._reply(
+                    200, out.getvalue(), ctype="application/octet-stream",
+                    headers={
+                        "X-Task-Id": str(res["task_id"]),
+                        "X-Replica": str(replica.replica_id),
+                        "X-Latency-Ms": f"{res['latency_ms']:.3f}",
+                    },
+                )
+
+            def _swap(self):
+                try:
+                    req = json.loads(self._body() or b"{}")
+                    result = replica.server.swap_to(int(req["task_id"]))
+                except Exception as e:  # noqa: BLE001 — becomes a 500
+                    self._reply_json(500, {"error": repr(e)})
+                    return
+                result["replica"] = replica.replica_id
+                self._reply_json(200 if result.get("ok") else 409, result)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._http_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "ReplicaServer":
+        self.server.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"replica-{self.replica_id}-http", daemon=True,
+        )
+        self._http_thread.start()
+        self._warmup()
+        return self
+
+    def _warmup(self) -> None:
+        """One self-inference so the first real request never pays a cold
+        queue + executable page-in; ``warm`` gates front-end re-admission."""
+        import numpy as np
+
+        meta = self.server._artifact.meta  # artifact is set post-start
+        x = np.zeros(
+            (meta["input_size"], meta["input_size"], meta["channels"]),
+            np.uint8,
+        )
+        self.server.submit(x).result(timeout=60.0)
+        self._warm.set()
+
+    def healthz(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "task_id": self.server.task_id,
+            "warm": self._warm.is_set(),
+            "served": self.server.stats()["served"],
+            "pid": os.getpid(),
+        }
+
+    def stop(self) -> None:
+        if self._http_thread is not None:
+            # shutdown() blocks on an event only serve_forever() sets; on a
+            # never-started replica it would wait forever.
+            self._httpd.shutdown()
+            self._http_thread.join()
+        self._httpd.server_close()
+        self.server.stop()
+
+
+# --------------------------------------------------------------------- #
+# Supervised fleet launcher (subprocess side)
+# --------------------------------------------------------------------- #
+
+
+def supervised_replica_cmd(
+    repo_root: str,
+    export_dir: str,
+    replica_id: int,
+    port: int,
+    telemetry_dir: str,
+    fault_spec: Optional[str] = None,
+    max_age_s: float = 15.0,
+    backoff_base: float = 0.2,
+    backoff_max: float = 2.0,
+    check_threads: bool = False,
+    python: Optional[str] = None,
+) -> list:
+    """The ``scripts/supervise.py`` command line that runs one replica as a
+    supervised subprocess — the same relaunch machinery training uses, so a
+    SIGKILL'd replica comes back on its own with jittered backoff.  The
+    replica's heartbeat lives under ``<telemetry_dir>/replica_<i>/``; the
+    resume flag is disabled (a replica has no checkpoint to resume)."""
+    import sys
+
+    py = python or sys.executable
+    rdir = os.path.join(telemetry_dir, f"replica_{replica_id}")
+    child = [
+        py, "-m", "serving.replica",
+        "--export_dir", export_dir,
+        "--replica_id", str(replica_id),
+        "--port", str(port),
+        "--telemetry_dir", rdir,
+    ]
+    if fault_spec:
+        child += ["--fault_spec", fault_spec,
+                  "--fault_ledger", os.path.join(rdir, "fault_ledger.jsonl")]
+    if check_threads:
+        child.append("--check_threads")
+    return [
+        py, os.path.join(repo_root, "scripts", "supervise.py"),
+        "--heartbeat", os.path.join(rdir, "heartbeat.json"),
+        "--max_age", str(max_age_s),
+        "--poll", "0.5", "--grace", "20",
+        "--backoff_base", str(backoff_base),
+        "--backoff_max", str(backoff_max),
+        "--backoff_seed", str(1000 + replica_id),
+        "--max_failures", "10", "--failure_window", "600",
+        "--resume_flag", "",
+        "--telemetry_dir", rdir,
+        "--log", os.path.join(rdir, "supervisor.jsonl"),
+        "--",
+    ] + child
+
+
+def main(argv=None) -> int:
+    """``python -m serving.replica`` — one replica process, serves until
+    SIGTERM/SIGKILL.  Run under ``scripts/supervise.py`` in fleets."""
+    import argparse
+
+    p = argparse.ArgumentParser("cil-tpu serving replica")
+    p.add_argument("--export_dir", required=True)
+    p.add_argument("--replica_id", type=int, required=True)
+    p.add_argument("--port", type=int, required=True,
+                   help="fixed port: the supervisor's relaunch must rebind "
+                   "the address the front end already routes to")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--max_wait_ms", type=float, default=2.0)
+    p.add_argument("--telemetry_dir", default=None)
+    p.add_argument("--fault_spec", default=None)
+    p.add_argument("--fault_ledger", default=None)
+    p.add_argument("--check_threads", action="store_true")
+    p.add_argument("--heartbeat_s", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    check = None
+    if args.check_threads:
+        from analysis import threadcheck
+
+        check = threadcheck.install()
+
+    telemetry = None
+    sink = None
+    if args.telemetry_dir:
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (  # noqa: E501
+            Telemetry,
+        )
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (  # noqa: E501
+            JsonlLogger,
+        )
+
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        sink = JsonlLogger(os.path.join(args.telemetry_dir, "run.jsonl"))
+        telemetry = Telemetry(
+            telemetry_dir=args.telemetry_dir, sink=sink,
+            heartbeat_interval_s=args.heartbeat_s,
+        )
+        if check is not None:
+            check.bind_sink(telemetry.sink)
+
+    faults = None
+    if args.fault_spec:
+        from faults.injector import injector_from
+
+        faults = injector_from(
+            args.fault_spec, ledger_path=args.fault_ledger,
+            sink=telemetry.sink if telemetry is not None else sink,
+        )
+
+    replica = ReplicaServer(
+        args.export_dir,
+        replica_id=args.replica_id,
+        port=args.port,
+        host=args.host,
+        max_wait_ms=args.max_wait_ms,
+        telemetry=telemetry,
+        sink=sink,
+        faults=faults,
+    ).start()
+    if telemetry is not None:
+        telemetry.heartbeat.update(force=True, phase="serve",
+                                   task=replica.server.task_id or 0)
+        telemetry.heartbeat.start()
+    print(f"| replica {args.replica_id} serving task "
+          f"{replica.server.task_id} on {replica.host}:{replica.port}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        replica.stop()
+        if telemetry is not None:
+            telemetry.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -72,10 +72,18 @@ class InferenceServer:
         faults=None,
         monitor: Optional[RecompileMonitor] = None,
         latency_log_every: int = 256,
+        auto_swap: bool = True,
+        replica_id: Optional[int] = None,
     ):
         self.export_dir = export_dir
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1000.0
         self.poll_s = float(poll_s)
+        # auto_swap=False puts swaps under external control (the fleet front
+        # end rolls replicas one at a time via swap_to); the watcher thread
+        # is simply not started.  replica_id tags this server's telemetry in
+        # fleet runs.
+        self.auto_swap = bool(auto_swap)
+        self.replica_id = replica_id
         self._telemetry = telemetry
         self._sink = (telemetry.sink if telemetry is not None else sink) or NullSink()
         self._faults = faults
@@ -97,6 +105,7 @@ class InferenceServer:
         self._bucket_counts: Dict[int, int] = {}
         self._swaps = 0
         self._swap_failures = 0
+        self._rollbacks = 0
         self._window_start = time.perf_counter()
         self._window_served = 0
         self._t0 = time.perf_counter()
@@ -122,11 +131,12 @@ class InferenceServer:
         self._batcher = threading.Thread(
             target=self._batcher_loop, name="serve-batcher", daemon=True
         )
-        self._watcher = threading.Thread(
-            target=self._watcher_loop, name="serve-watcher", daemon=True
-        )
         self._batcher.start()
-        self._watcher.start()
+        if self.auto_swap:
+            self._watcher = threading.Thread(
+                target=self._watcher_loop, name="serve-watcher", daemon=True
+            )
+            self._watcher.start()
         return self
 
     def stop(self) -> None:
@@ -187,6 +197,7 @@ class InferenceServer:
                 "task_id": self._artifact.task_id if self._artifact else None,
                 "swaps": self._swaps,
                 "swap_failures": self._swap_failures,
+                "rollbacks": self._rollbacks,
                 "bucket_counts": dict(self._bucket_counts),
                 "bucket_occupancy": (
                     round(self._served / self._slots, 4) if self._slots else 0.0
@@ -336,6 +347,79 @@ class InferenceServer:
             f"| serve: swapped task {current} -> {art.task_id} "
             f"(load {art.load_ms:.0f} ms, compile {art.compile_ms:.0f} ms)"
         )
+
+    def swap_to(self, task_id: int) -> dict:
+        """Externally driven, skew-gated swap (the fleet's rolling-update
+        primitive; requires ``auto_swap=False`` only by convention — the
+        caller owns the cadence).
+
+        Load + AOT-compile the target artifact, then replay its golden
+        probe (``serving/skew.py probe_artifact``) through the freshly
+        compiled executables BEFORE promotion.  Any failure — injected
+        ``swap_ioerror``, unreadable artifact, probe mismatch — keeps the
+        current artifact serving, emits ``serve_rollback``, and reports
+        ``ok=False``; the rest of the fleet is the caller's problem, this
+        replica just refuses to get worse.  In-flight batches always finish
+        on the artifact they started with.
+        """
+        task_id = int(task_id)
+        with self._lock:
+            current = self._artifact.task_id if self._artifact else None
+        if current == task_id:
+            return {"ok": True, "task_id": task_id, "noop": True}
+        probe = None
+        try:
+            # task coordinate = swap TARGET (same as the auto-swap path);
+            # per-replica injection comes from each replica owning its own
+            # injector + ledger, not from the coordinate.
+            if self._faults is not None:
+                actions = self._faults.fire("serve.swap", task=task_id)
+                if "swap_ioerror" in actions:
+                    raise OSError(
+                        f"fault-injected swap failure (task {task_id})"
+                    )
+            art = self._load(task_id)
+            from .skew import probe_artifact
+
+            probe = probe_artifact(art)
+            if not probe["ok"]:
+                raise OSError(
+                    f"post-swap probe mismatch "
+                    f"(max_abs={probe['max_abs']}, "
+                    f"{probe.get('error', 'logits differ')})"
+                )
+        except Exception as e:
+            with self._lock:
+                self._swap_failures += 1
+                self._rollbacks += 1
+            record = dict(task_id=task_id, rolled_back_to=current,
+                          reason=repr(e))
+            if self.replica_id is not None:
+                record["replica"] = self.replica_id
+            if probe is not None:
+                record["probe_checked"] = bool(probe.get("checked"))
+                if probe.get("max_abs", 0.0) not in (None, float("inf")):
+                    record["probe_max_abs"] = float(probe["max_abs"])
+            self._sink.log("serve_rollback", **record)
+            print(
+                f"| serve: swap to task {task_id} rolled back ({e!r}); "
+                f"still serving task {current}"
+            )
+            return {"ok": False, "task_id": current, "target": task_id,
+                    "error": repr(e)}
+        with self._lock:
+            self._artifact = art
+            self._swaps += 1
+        self._sink.log(
+            "serve_swap", from_task=current, to_task=art.task_id,
+            load_ms=art.load_ms, compile_ms=art.compile_ms, path=art.path,
+        )
+        print(
+            f"| serve: swapped task {current} -> {art.task_id} "
+            f"(probe {'ok' if probe and probe['checked'] else 'absent'})"
+        )
+        return {"ok": True, "task_id": art.task_id,
+                "probe_checked": bool(probe and probe.get("checked"))}
 
     def _load(self, task_id: int, manifest: Optional[dict] = None
               ) -> ServingArtifact:

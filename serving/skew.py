@@ -13,16 +13,29 @@ the per-task served accuracies with the training-side row (the ``task``
 record's ``acc_per_task``).  For a healthy artifact the skew is exactly
 zero: the exported program is the same computation as the trainer's eval
 step at the same batch shapes.
+
+``probe_artifact`` is the *online* flavor of the same question: the export
+froze a golden ``probe.npz`` (deterministic input + the logits the program
+produced at export time, ``serving/artifact.py``), and a freshly swapped-in
+replica replays it through its own AOT executables demanding exact
+equality.  It needs no validation set, runs in one bucket-sized inference,
+and is the promotion gate of the fleet's rolling swaps — a probe miss rolls
+that replica back (``serve_rollback``) instead of serving skewed logits.
 """
 
 from __future__ import annotations
 
+import io
+import os
 from typing import Optional, Sequence
 
 import numpy as np
 
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.datasets import (
     maybe_decode,
+)
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.checkpoint import (
+    _sha256_file,
 )
 
 
@@ -82,3 +95,50 @@ def measure_skew(
     if sink is not None:
         sink.log("serve_skew", **record)
     return record
+
+
+def probe_artifact(artifact) -> dict:
+    """Replay the artifact's golden probe through its loaded executables.
+
+    Returns ``{"ok": bool, "checked": bool, "max_abs": float, ...}``.
+    ``ok`` is the promotion verdict: exact bit-equality with the logits the
+    export froze (the exported program is deterministic — any difference
+    means the artifact on disk is not the artifact that was exported, or the
+    load resolved to different code).  Artifacts from before the probe
+    existed pass with ``checked=False`` — absence of evidence is not skew.
+    A corrupt probe file (checksum/read failure) FAILS: during a rolling
+    swap, an unverifiable artifact must not be promoted.
+    """
+    probe_name = artifact.meta.get("files", {}).get("probe")
+    if not probe_name:
+        return {"ok": True, "checked": False, "max_abs": 0.0}
+    path = os.path.join(artifact.path, probe_name)
+    try:
+        sidecar = path + ".sha256"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                want = f.read().strip()
+            got = _sha256_file(path)
+            if got != want:
+                return {"ok": False, "checked": True, "max_abs": float("inf"),
+                        "error": f"probe checksum mismatch ({got[:12]})"}
+        with open(path, "rb") as f:
+            blob = np.load(io.BytesIO(f.read()))
+        probe_x = blob["x"]
+        want_logits = blob["logits"]
+        bucket = int(blob["bucket"])
+    except (OSError, ValueError, KeyError) as e:
+        return {"ok": False, "checked": True, "max_abs": float("inf"),
+                "error": f"unreadable probe: {e!r}"}
+    if bucket not in artifact.buckets:
+        return {"ok": False, "checked": True, "max_abs": float("inf"),
+                "error": f"probe bucket {bucket} not loaded"}
+    got_logits = artifact.predict_padded(probe_x, bucket)
+    max_abs = float(np.max(np.abs(
+        got_logits.astype(np.float64) - want_logits.astype(np.float64)
+    )))
+    return {
+        "ok": bool(np.array_equal(got_logits, want_logits)),
+        "checked": True,
+        "max_abs": max_abs,
+    }

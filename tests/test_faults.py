@@ -575,3 +575,129 @@ def test_rotate_ledger_archives_and_numbers(tmp_path):
         f.write(json.dumps({"spec": "raise@task0", "action": "raise"}) + "\n")
     assert rotate_ledger(path) == path + ".2"
     assert os.path.exists(first)
+
+
+# --------------------------------------------------------------------------- #
+# Decorrelated-jitter restart backoff (scripts/supervise.py)
+# --------------------------------------------------------------------------- #
+
+
+def test_backoff_delay_bounds_and_growth():
+    import random
+
+    sup = _load_script("supervise")
+    rng = random.Random(1234)
+    base, cap = 0.1, 2.0
+    prev, seen_cap = 0.0, False
+    for _ in range(200):
+        d = sup.backoff_delay(rng, base, cap, prev)
+        # AWS decorrelated jitter: base <= d <= min(cap, max(base, prev*3)).
+        assert base <= d <= cap
+        assert d <= max(base, prev * 3.0) + 1e-12
+        seen_cap = seen_cap or d > cap * 0.9
+        prev = d
+    assert seen_cap  # the walk actually reaches the cap region
+
+
+def test_backoff_first_delay_is_exactly_base():
+    import random
+
+    sup = _load_script("supervise")
+    # prev=0 collapses the jitter interval to [base, base]: a first crash
+    # restarts fast and deterministically.
+    assert sup.backoff_delay(random.Random(0), 0.5, 10.0, 0.0) == 0.5
+
+
+def test_backoff_seeded_sequence_reproducible():
+    import random
+
+    sup = _load_script("supervise")
+
+    def walk(seed):
+        rng, prev, out = random.Random(seed), 0.0, []
+        for _ in range(10):
+            prev = sup.backoff_delay(rng, 0.1, 2.0, prev)
+            out.append(prev)
+        return out
+
+    assert walk(7) == walk(7)
+    assert walk(7) != walk(8)
+
+
+def test_supervisor_accepts_backoff_seed(tmp_path):
+    rc, attempts = _run_supervisor(
+        tmp_path, crashes=1, extra=("--backoff_seed", "42"))
+    assert rc == 0
+    assert len(attempts) == 2
+
+
+# --------------------------------------------------------------------------- #
+# End-of-epoch reconciliation of step-level clauses (fused-epoch path)
+# --------------------------------------------------------------------------- #
+
+
+def test_reconcile_fires_reached_step_marked_reconciled(tmp_path):
+    sink = FakeSink()
+    inj = injector_from("raise@task0.epoch1.step2", sink=sink,
+                        ledger_path=str(tmp_path / "ledger.jsonl"))
+    # The fused epoch never visits engine.step per batch; the end-of-epoch
+    # reconciliation settles every armed step clause the epoch reached.
+    with pytest.raises(FaultInjected) as ei:
+        inj.reconcile_steps("engine.step", task=0, epoch=1, steps=5)
+    assert ei.value.coords["step"] == 2
+    assert inj.armed == ()
+    rec = [r for r in sink.records if r["type"] == "fault_injected"]
+    assert len(rec) == 1 and rec[0]["reconciled"] is True
+    entry = json.loads(open(tmp_path / "ledger.jsonl").read())
+    assert entry["reconciled"] is True
+
+
+def test_reconcile_keeps_unreached_steps_armed():
+    inj = injector_from("raise@task0.epoch1.step9")
+    # Epoch ended after 5 steps: a step-9 clause never happened.
+    inj.reconcile_steps("engine.step", task=0, epoch=1, steps=5)
+    assert len(inj.armed) == 1
+
+
+def test_reconcile_fires_in_ascending_step_order():
+    inj = injector_from("raise@task0.epoch1.step3,raise@task0.epoch1.step1")
+    with pytest.raises(FaultInjected) as ei:
+        inj.reconcile_steps("engine.step", task=0, epoch=1, steps=5)
+    # Spec order is 3-then-1, execution order must be 1-then-3.
+    assert ei.value.coords["step"] == 1
+
+
+def test_reconcile_ignores_other_epochs_and_sites():
+    inj = injector_from("raise@task0.epoch2.step1")
+    inj.reconcile_steps("engine.step", task=0, epoch=1, steps=5)
+    inj.reconcile_steps("data.produce", task=0, epoch=2, steps=5)
+    assert len(inj.armed) == 1
+    with pytest.raises(FaultInjected):
+        inj.reconcile_steps("engine.step", task=0, epoch=2, steps=5)
+
+
+@pytest.mark.heavy
+def test_step_clause_fires_inside_fused_epoch(devices8, tmp_path):
+    """Regression for the PR 5 carry-over: a ``stepS`` clause used to be
+    silently unreachable under fused epochs (no per-batch host hop exists to
+    fire it).  The end-of-epoch reconciliation must fire it host-side."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import (
+        CilTrainer,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    ckpt = str(tmp_path / "ckpts")
+    t = CilTrainer(
+        _cfg(ckpt_dir=ckpt, num_epochs=1,
+             fault_spec="raise@task0.epoch1.step2"),
+        mesh=make_mesh((8, 1)), init_dist=False,
+    )
+    assert t.cfg.fused_epochs  # the whole point: the fused path, not per-step
+    with pytest.raises(FaultInjected) as ei:
+        t.fit()
+    assert ei.value.coords["step"] == 2
+    ledger = [json.loads(line) for line in
+              open(os.path.join(ckpt, "fault_ledger.jsonl"))]
+    assert len(ledger) == 1 and ledger[0]["reconciled"] is True

@@ -545,3 +545,118 @@ def test_schema_accepts_heartbeat_mono(tmp_path):
     hb.write_text(json.dumps({"ts": 1.0, "seq": 1, "pid": 7, "mono": 42.5,
                               "process_index": 0}))
     assert m.check_file(str(hb)) == []
+
+
+# --------------------------------------------------------------------------- #
+# perf_gate: the overload (fleet) verdict logic
+# --------------------------------------------------------------------------- #
+
+_OVERLOAD_BASE = {"p99_high_ms": 100.0, "backend": "cpu", "replicas": 2,
+                  "pattern": "bursty", "rps": 40.0, "tolerance": 0.15}
+
+
+def _overload_result(**over):
+    out = {"value": 99.0, "p99_high_ms": 99.0, "backend": "cpu",
+           "replicas": 2, "pattern": "bursty", "rps": 40.0, "capacity": 24,
+           "errors": 0}
+    out.update(over)
+    return out
+
+
+def test_overload_gate_passes_within_tolerance():
+    m = _load_script("perf_gate")
+    v = m.gate_serve_overload(_overload_result(p99_high_ms=110.0),
+                              _OVERLOAD_BASE)
+    assert v["status"] == "pass" and v["reasons"] == []
+
+
+def test_overload_gate_fails_high_p99_regression():
+    m = _load_script("perf_gate")
+    v = m.gate_serve_overload(_overload_result(p99_high_ms=130.0),
+                              _OVERLOAD_BASE)
+    assert v["status"] == "fail"
+    assert any("p99_high_ms regressed" in r for r in v["reasons"])
+
+
+def test_overload_gate_fails_on_any_hard_error():
+    # Sheds are the mechanism under test; hard errors are a resilience bug
+    # regardless of how good the latency numbers look.
+    m = _load_script("perf_gate")
+    v = m.gate_serve_overload(_overload_result(errors=1), _OVERLOAD_BASE)
+    assert v["status"] == "fail"
+    assert any("hard-failed" in r for r in v["reasons"])
+
+
+def test_overload_gate_skips_incomparable_pattern():
+    m = _load_script("perf_gate")
+    v = m.gate_serve_overload(_overload_result(pattern="steady"),
+                              _OVERLOAD_BASE)
+    assert v["status"] == "skip"
+    assert "incomparable pattern" in v["reasons"][0]
+    assert m.gate_serve_overload(_overload_result(), {})["status"] == "skip"
+
+
+def test_overload_gate_cli_update_and_gate(tmp_path):
+    m = _load_script("perf_gate")
+    base = str(tmp_path / "BASELINE.json")
+    canned = json.dumps(_overload_result())
+    assert m.main(["--serve-overload", "--update-baseline",
+                   "--result", canned, "--baseline", base]) == 0
+    doc = json.load(open(base))
+    assert doc["serve_overload_gate"]["p99_high_ms"] == 99.0
+    assert doc["serve_overload_gate"]["pattern"] == "bursty"
+    assert m.main(["--serve-overload", "--result", canned,
+                   "--baseline", base]) == 0
+    slow = json.dumps(_overload_result(p99_high_ms=200.0))
+    assert m.main(["--serve-overload", "--result", slow,
+                   "--baseline", base]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# telemetry schema: the fleet resilience record types
+# --------------------------------------------------------------------------- #
+
+
+def test_schema_accepts_fleet_resilience_records():
+    m = _load_script("check_telemetry_schema")
+    shed = {"type": "serve_shed", "ts": 1.0, "priority": "low",
+            "queued": 6, "capacity": 2, "shed_total": 41}
+    assert m.check_record(shed, "x") == []
+    eject = {"type": "replica_ejected", "ts": 2.0, "replica": 1,
+             "event": "eject", "reason": "consecutive_errors",
+             "consecutive_errors": 3}
+    assert m.check_record(eject, "x") == []
+    readmit = {"type": "replica_ejected", "ts": 3.0, "replica": 1,
+               "event": "readmit", "reason": "probe_ok"}
+    assert m.check_record(readmit, "x") == []
+    rollback = {"type": "serve_rollback", "ts": 4.0, "task_id": 1,
+                "rolled_back_to": 0, "replica": 2, "probe_checked": True,
+                "probe_max_abs": 0.25, "reason": "probe mismatch"}
+    assert m.check_record(rollback, "x") == []
+    # rolled_back_to may be null: a replica that never loaded anything.
+    assert m.check_record(dict(rollback, rolled_back_to=None), "x") == []
+    retry = {"type": "frontend_retry", "ts": 5.0, "replica": 0,
+             "attempt": 2, "error": "ConnectionRefusedError(111)"}
+    assert m.check_record(retry, "x") == []
+
+
+def test_schema_rejects_malformed_fleet_records():
+    m = _load_script("check_telemetry_schema")
+    assert any("priority" in e for e in m.check_record(
+        {"type": "serve_shed", "ts": 1.0, "queued": 6, "capacity": 2}, "x"))
+    assert any("event" in e for e in m.check_record(
+        {"type": "replica_ejected", "ts": 1.0, "replica": 0,
+         "reason": "x"}, "x"))
+    assert any("reason" in e for e in m.check_record(
+        {"type": "serve_rollback", "ts": 1.0, "task_id": 1,
+         "rolled_back_to": 0}, "x"))
+
+
+def test_schema_accepts_reconciled_fault_record():
+    m = _load_script("check_telemetry_schema")
+    rec = {"type": "fault_injected", "ts": 1.0, "spec": "raise@task0.step2",
+           "action": "raise", "site": "engine.step",
+           "task": 0, "epoch": 1, "step": 2, "reconciled": True}
+    assert m.check_record(rec, "x") == []
+    bad = dict(rec, reconciled="yes")
+    assert any("reconciled" in e for e in m.check_record(bad, "x"))

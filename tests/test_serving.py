@@ -273,3 +273,123 @@ def test_hot_swap_failure_degrades_gracefully(export_dir, tmp_path):
              if ln.strip() and json.loads(ln)["type"] == "serve_swap"]
     assert [s["to_task"] for s in swaps] == [0, 1]
     assert swaps[0]["from_task"] is None and swaps[1]["from_task"] == 0
+
+
+# --------------------------------------------------------------------------- #
+# Skew-gated explicit swaps (the fleet rollout path, ISSUE 12)
+# --------------------------------------------------------------------------- #
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def log(self, rtype, **fields):
+        self.records.append({"type": rtype, **fields})
+
+
+def _stage(export_dir, tmp_path, *tasks):
+    serve_dir = str(tmp_path / "serve")
+    os.makedirs(serve_dir)
+    for t in tasks:
+        name = f"task_{t:03d}"
+        shutil.copytree(os.path.join(export_dir, name),
+                        os.path.join(serve_dir, name))
+        register_artifact(serve_dir, t, {"path": name})
+    return serve_dir
+
+
+def test_probe_artifact_replays_exactly(export_dir):
+    from serving import load_artifact, probe_artifact
+
+    art = load_artifact(os.path.join(export_dir, "task_000"))
+    verdict = probe_artifact(art)
+    assert verdict == {"ok": True, "checked": True, "max_abs": 0.0}
+
+
+def test_probe_artifact_unchecked_for_pre_probe_artifacts(export_dir,
+                                                          tmp_path):
+    from serving import load_artifact, probe_artifact
+
+    serve_dir = _stage(export_dir, tmp_path, 0)
+    apath = os.path.join(serve_dir, "task_000")
+    os.unlink(os.path.join(apath, "probe.npz"))
+    os.unlink(os.path.join(apath, "probe.npz.sha256"))
+    meta_path = os.path.join(apath, "meta.json")
+    meta = json.load(open(meta_path))
+    meta["files"].pop("probe")
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    # A pre-probe artifact passes unchecked: absence of evidence != skew.
+    verdict = probe_artifact(load_artifact(apath))
+    assert verdict["ok"] and not verdict["checked"]
+
+
+def _tamper_probe(apath):
+    """Perturb the frozen logits and re-sign the sidecar: the file is
+    'valid' at the checksum layer, but the replay must catch the drift."""
+    import hashlib
+    import io as _io
+
+    probe_path = os.path.join(apath, "probe.npz")
+    blob = np.load(probe_path)
+    buf = _io.BytesIO()
+    np.savez(buf, x=blob["x"], logits=blob["logits"] + 1e-3,
+             bucket=blob["bucket"])
+    with open(probe_path, "wb") as f:
+        f.write(buf.getvalue())
+    with open(probe_path + ".sha256", "w") as f:
+        f.write(hashlib.sha256(buf.getvalue()).hexdigest())
+
+
+@pytest.mark.heavy
+def test_swap_to_rolls_back_on_probe_skew(export_dir, tmp_path):
+    """A republished artifact whose outputs drifted from its frozen probe
+    must NOT be promoted: swap_to keeps serving the old task and emits
+    serve_rollback with the measured drift."""
+    serve_dir = _stage(export_dir, tmp_path, 0)
+    sink = _ListSink()
+    server = InferenceServer(serve_dir, max_wait_ms=1.0, sink=sink,
+                             auto_swap=False, replica_id=2).start()
+    try:
+        shutil.copytree(os.path.join(export_dir, "task_001"),
+                        os.path.join(serve_dir, "task_001"))
+        _tamper_probe(os.path.join(serve_dir, "task_001"))
+        register_artifact(serve_dir, 1, {"path": "task_001"})
+        out = server.swap_to(1)
+        assert out["ok"] is False and server.task_id == 0
+        rb = [r for r in sink.records if r["type"] == "serve_rollback"]
+        assert len(rb) == 1
+        assert rb[0]["replica"] == 2 and rb[0]["rolled_back_to"] == 0
+        assert rb[0]["probe_checked"] and rb[0]["probe_max_abs"] > 0
+        # The server still answers on the old artifact after the refusal.
+        res = server.submit(_img(np.random.RandomState(0))).result(timeout=60)
+        assert res["task_id"] == 0
+    finally:
+        server.stop()
+
+
+@pytest.mark.heavy
+def test_swap_to_fault_rolls_back_then_succeeds(export_dir, tmp_path):
+    """The explicit rollout swap honors the same ``serve.swap`` fault site
+    as the auto-swap watcher; the one-shot clause spends on the refusal."""
+    serve_dir = _stage(export_dir, tmp_path, 0)
+    sink = _ListSink()
+    inj = FaultInjector(parse_fault_spec("swap_ioerror@task1"),
+                        ledger_path=str(tmp_path / "ledger.jsonl"), sink=sink)
+    server = InferenceServer(serve_dir, max_wait_ms=1.0, sink=sink,
+                             faults=inj, auto_swap=False).start()
+    try:
+        shutil.copytree(os.path.join(export_dir, "task_001"),
+                        os.path.join(serve_dir, "task_001"))
+        register_artifact(serve_dir, 1, {"path": "task_001"})
+        out = server.swap_to(1)
+        assert out["ok"] is False and server.task_id == 0
+        assert [r["type"] for r in sink.records].count("serve_rollback") == 1
+        out = server.swap_to(1)
+        assert out["ok"] is True and server.task_id == 1
+        assert server.swap_to(1).get("noop")  # idempotent once converged
+        assert server.stats()["rollbacks"] == 1
+        assert server.trace_count() == 0
+    finally:
+        server.stop()
