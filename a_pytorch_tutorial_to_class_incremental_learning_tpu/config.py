@@ -169,6 +169,16 @@ class CilConfig:
     # threading.Lock/RLock to detect lock-order inversions and lock-held
     # blocking calls at runtime; each emits a thread_violation record
     # (analysis/threadcheck.py; the chaos/serve smokes fail on any)
+    check_lockstep: bool = False  # LockstepSentinel: fingerprint every
+    # train/eval program dispatch (program + arg shapes + batch digest + RNG
+    # coords), exchange fingerprints across the fleet, and fail with a named
+    # lockstep_violation record + flight dumps on every process *before* a
+    # divergent dispatch would hang the pod (analysis/lockstep.py)
+    lockstep_dir: Optional[str] = None  # fingerprint exchange directory
+    # (shared across processes); defaults to <telemetry_dir>/lockstep, then
+    # <ckpt_dir>/lockstep
+    lockstep_deadline_s: float = 120.0  # exchange poll deadline: a peer that
+    # publishes nothing for this long surfaces as kind="peer_timeout"
 
     # Profiling (SURVEY.md §5: absent in the reference; near-free here)
     profile_dir: Optional[str] = None  # trace each task's first epoch
@@ -323,6 +333,19 @@ def get_args_parser() -> argparse.ArgumentParser:
                    "held-lock sets and global acquisition order, emit a "
                    "thread_violation record on any lock-order inversion or "
                    "lock-held blocking call (analysis/threadcheck.py)")
+    p.add_argument("--check_lockstep", action="store_true", default=False,
+                   help="install the LockstepSentinel: fingerprint every "
+                   "train/eval dispatch (program + arg shapes + batch digest "
+                   "+ RNG coords), exchange across the fleet, and fail with "
+                   "a named lockstep_violation + flight dumps before a "
+                   "divergent dispatch hangs the pod (analysis/lockstep.py)")
+    p.add_argument("--lockstep_dir", default=None, type=str,
+                   help="fingerprint exchange directory shared by all "
+                   "processes; defaults to <telemetry_dir>/lockstep, then "
+                   "<ckpt_dir>/lockstep")
+    p.add_argument("--lockstep_deadline_s", default=120.0, type=float,
+                   help="lockstep exchange poll deadline: a peer silent for "
+                   "this long is reported as kind=peer_timeout")
     p.add_argument("--profile_dir", default=None, type=str,
                    help="write a jax.profiler trace of each task's first epoch")
     p.add_argument("--log_file", default=None, type=str,
@@ -451,6 +474,9 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         recompile_budget=args.recompile_budget,
         check_donation=args.check_donation,
         check_threads=args.check_threads,
+        check_lockstep=args.check_lockstep,
+        lockstep_dir=args.lockstep_dir,
+        lockstep_deadline_s=args.lockstep_deadline_s,
         profile_dir=args.profile_dir,
         log_file=args.log_file,
         telemetry_dir=args.telemetry_dir,

@@ -42,7 +42,7 @@ from ..data import (
 )
 from ..data.augment import AugmentConfig
 from ..models import align, create_model, grow, init_backbone
-from ..parallel.dist import init_distributed_mode
+from ..parallel.dist import barrier, init_distributed_mode
 from ..parallel.mesh import (
     assert_process_major,
     batch_sharding,
@@ -119,6 +119,37 @@ class CilTrainer:
         self.jsonl = self.telemetry.sink
         if self.threadcheck is not None:
             self.threadcheck.bind_sink(self.jsonl)
+        # Opt-in runtime contract #2 (--check_lockstep): fingerprint every
+        # imminent train/eval dispatch and compare across the fleet, so a
+        # divergent process surfaces as a named record on every host instead
+        # of a silent pod-wide hang in the next collective.  The exchange dir
+        # defaults next to the other run artifacts; construction clears this
+        # process's own subdirectory, so the barrier below is load-bearing —
+        # no peer may publish seq 0 before every stale file is gone.
+        self.lockstep = None
+        self._lockstep_digest = None
+        if config.check_lockstep:
+            from analysis.lockstep import LockstepSentinel, data_digest
+
+            self._lockstep_digest = data_digest
+
+            lockstep_dir = config.lockstep_dir
+            if lockstep_dir is None and config.telemetry_dir:
+                lockstep_dir = os.path.join(config.telemetry_dir, "lockstep")
+            if lockstep_dir is None and config.ckpt_dir:
+                lockstep_dir = os.path.join(config.ckpt_dir, "lockstep")
+            self.lockstep = LockstepSentinel(
+                lockstep_dir,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                sink=self.jsonl,
+                on_fatal=(
+                    self.telemetry.flight.fatal_dump
+                    if self.telemetry.flight is not None else None
+                ),
+                deadline_s=config.lockstep_deadline_s,
+            )
+            barrier()
         # Deterministic fault injection (--fault_spec; faults/injector.py).
         # None when unset, so every hot-path site pays one identity check.
         # The ledger defaults next to the checkpoints: a supervised relaunch
@@ -615,6 +646,13 @@ class CilTrainer:
             rep = replicated(self.mesh)
             # Dataset lives in HBM for the whole task (CIFAR-100: 150 MB).
             data_x, data_y = self._put(task_train.x, task_train.y, sharding=rep)
+            # One digest per task (not per epoch): the fused program consumes
+            # the whole resident dataset, so this is the finest granularity
+            # the host ever sees on this path.
+            task_digest = (
+                self._lockstep_digest(task_train.x, task_train.y)
+                if self.lockstep is not None else None
+            )
         lam = self._lambda_kd(task_id)
         from ..utils.profiling import task_trace
 
@@ -633,7 +671,8 @@ class CilTrainer:
             ), task_trace(profile_here, f"task{task_id}_epoch0") as trace_path:
                 if fused:
                     pending = self._run_epoch_fused(
-                        data_x, data_y, epoch_key, lr, lam, clock
+                        data_x, data_y, epoch_key, lr, lam, clock,
+                        task_id=task_id, epoch=epoch, task_digest=task_digest,
                     )
                     # The fused epoch is one opaque device program: the
                     # per-step fire site never runs.  Settle step-level
@@ -775,8 +814,16 @@ class CilTrainer:
             # process-consistent); per-image randomness comes from the
             # split over the global batch inside train_augment.
             key = jax.random.fold_in(epoch_key, step_idx)
+            # Lockstep digest over the HOST batch, on the producer thread:
+            # free overlap with device compute at prefetch_depth > 0, and it
+            # witnesses the data *this process* read — exactly the thing a
+            # divergent input pipeline corrupts.
+            digest = (
+                self._lockstep_digest(xb, yb)
+                if self.lockstep is not None else None
+            )
             x, y = self._put(xb, yb)
-            return x, y, key
+            return x, y, key, digest
 
         def _degraded(exc):
             self.jsonl.log(
@@ -799,8 +846,22 @@ class CilTrainer:
             on_degrade=_degraded,
         ) as batches:
             step_no = 0
-            for x, y, key in batches:
+            for x, y, key, digest in batches:
                 t_step = time.perf_counter()
+                if self.lockstep is not None:
+                    # BEFORE the dispatch: a mismatch must surface while every
+                    # process is still on the host side of the collective.
+                    self.lockstep.check(
+                        "train_step",
+                        program=("train_step_kd" if self.teacher is not None
+                                 else "train_step"),
+                        args=(x, y, key),
+                        digest=digest,
+                        rng=(task_id, epoch, step_no),
+                        step=self._global_step + 1,
+                        task=task_id,
+                        epoch=epoch + 1,
+                    )
                 with clock.device():
                     self.state, metrics = step_fn(
                         self.state, self.teacher, x, y, key, lr, lam
@@ -842,10 +903,25 @@ class CilTrainer:
         lr: float,
         lam: float,
         clock: Optional[StallClock] = None,
+        task_id: Optional[int] = None,
+        epoch: Optional[int] = None,
+        task_digest: Optional[str] = None,
     ):
         """One ``lax.scan`` program for the whole epoch (see ``make_epoch_fn``)."""
         epoch_fn = self._epochs[self.teacher is not None]
         clock = clock if clock is not None else StallClock()
+        if self.lockstep is not None:
+            self.lockstep.check(
+                "train_epoch_fused",
+                program=("epoch_fn_kd" if self.teacher is not None
+                         else "epoch_fn"),
+                args=(data_x, data_y, epoch_key),
+                digest=task_digest,
+                rng=(task_id, epoch) if task_id is not None else None,
+                step=self._global_step + 1,
+                task=task_id,
+                epoch=(epoch + 1) if epoch is not None else None,
+            )
         with clock.device():  # the epoch is one program + one blocking fetch
             self.state, metrics = epoch_fn(
                 self.state,
@@ -898,6 +974,16 @@ class CilTrainer:
             on_degrade=_degraded,
         ) as batches:
             for x, y, w in batches:
+                if self.lockstep is not None:
+                    # Shape/count lockstep only: the operands are already on
+                    # device, and a digest would cost a D2H transfer per
+                    # batch.  A divergent eval stream still trips here — the
+                    # padded batch counts or shard shapes disagree first.
+                    self.lockstep.check(
+                        "eval_step",
+                        program=f"eval_step@known{self.known}",
+                        args=(x, y, w),
+                    )
                 out = self.eval_step(
                     self.state.params,
                     self.state.batch_stats,
@@ -961,6 +1047,16 @@ class CilTrainer:
             on_degrade=_degraded,
         ) as batches:
             for x, key in batches:
+                if self.lockstep is not None:
+                    # Herding is replicated-by-construction (identical full
+                    # pass on every process); lockstep turns "construction"
+                    # into a checked invariant.
+                    self.lockstep.check(
+                        "feature_step",
+                        program="feature_step",
+                        args=(x, key),
+                        task=task_id,
+                    )
                 f = self.feature_step(
                     self.state.params, self.state.batch_stats, x, key
                 )

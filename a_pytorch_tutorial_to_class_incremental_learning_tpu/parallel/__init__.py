@@ -9,6 +9,7 @@ from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    global_put,
     make_mesh,
     param_sharding,
     replicated,

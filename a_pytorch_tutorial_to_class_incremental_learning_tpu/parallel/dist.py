@@ -64,6 +64,17 @@ def init_distributed_mode(dist_url: Optional[str] = None) -> None:
     global _dist_initialized
     if not _dist_initialized and is_dist_env():
         _dist_initialized = True
+        # Multi-process on the CPU platform needs an explicit collectives
+        # implementation: without one the XLA CPU client rejects every
+        # cross-process computation ("Multiprocess computations aren't
+        # implemented").  Config-only — the backend is not touched.
+        if "cpu" in str(jax.config.jax_platforms or "").split(","):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except (AttributeError, ValueError):
+                pass  # older/newer jax: flag absent or gloo not built in
         coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
             "COORDINATOR_ADDRESS"
         )
@@ -125,14 +136,38 @@ def setup_for_distributed(is_master: bool) -> None:
     builtins.print = print_
 
 
-def barrier() -> None:
+# Each barrier use needs a fresh id on the coordination service (a passed
+# barrier cannot be re-waited).  Every process executes the same barrier
+# sequence (SPMD), so a plain counter agrees fleet-wide.
+_barrier_seq = 0
+
+
+def barrier(timeout_s: float = 600.0) -> None:
     """Block until every process reaches this point.
 
-    Implemented as a host-level allgather of a scalar — the idiomatic JAX
+    Preferred path: the ``jax.distributed`` coordination service — a pure
+    host-side TCP rendezvous that works on every backend (the XLA CPU
+    backend rejects cross-process device computations, so a device-collective
+    barrier would crash exactly where the CPU test clusters need it).
+    Fallback: a scalar ``process_allgather``, the idiomatic device-level
     replacement for ``dist.barrier()`` (reference utils.py:152,
     template.py:210).  No-op single-process.
     """
     if jax.process_count() == 1:
+        return
+    client = None
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+    except (ImportError, AttributeError):  # pragma: no cover - jax internals
+        client = None
+    if client is not None:
+        global _barrier_seq
+        _barrier_seq += 1
+        client.wait_at_barrier(
+            f"cil_barrier_{_barrier_seq}", timeout_in_ms=int(timeout_s * 1e3)
+        )
         return
     from jax.experimental import multihost_utils
 

@@ -91,6 +91,27 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def global_put(value, sharding: NamedSharding):
+    """Place one host value onto a (possibly multi-process) sharding.
+
+    ``jax.device_put`` of a host value to a non-fully-addressable sharding
+    runs ``multihost_utils.assert_equal`` — a device-collective broadcast
+    the XLA CPU backend rejects outright (and a per-placement synchronous
+    collective everywhere else).  Each process instead assembles its
+    addressable shards straight from its own host copy, the same trust-based
+    contract as the batch path (``make_array_from_process_local_data``):
+    every process is *assumed* to hold the same value.  That assumption is
+    exactly what ``--check_lockstep`` verifies at every dispatch boundary,
+    with a named violation instead of an opaque placement-time crash.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def replicated_scalar(mesh: Mesh, value, dtype=None):
     """An int32 (or ``dtype``) scalar committed to the replicated mesh sharding.
 
@@ -103,7 +124,7 @@ def replicated_scalar(mesh: Mesh, value, dtype=None):
     """
     import jax.numpy as jnp
 
-    return jax.device_put(
+    return global_put(
         jnp.asarray(value, dtype or jnp.int32), replicated(mesh)
     )
 
@@ -144,6 +165,6 @@ def shard_params(mesh: Mesh, tree):
         names = tuple(
             getattr(k, "key", getattr(k, "name", str(k))) for k in path
         )
-        return jax.device_put(leaf, param_sharding(mesh, names, leaf))
+        return global_put(leaf, param_sharding(mesh, names, leaf))
 
     return jtu.tree_map_with_path(place, tree)

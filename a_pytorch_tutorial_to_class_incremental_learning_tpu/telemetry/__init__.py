@@ -128,9 +128,9 @@ class Telemetry:
         Telemetry objects in one process don't stack handlers)."""
         self.heartbeat.stop()
         if self.spans.enabled:
-            name = "trace.json" if not self.spans.process_index else \
-                f"trace_p{self.spans.process_index}.json"
-            self.spans.export_chrome_trace(os.path.join(self.dir, name))
+            # export_chrome_trace applies process_suffixed itself: process 0
+            # keeps trace.json, process i writes trace_p{i}.json.
+            self.spans.export_chrome_trace(os.path.join(self.dir, "trace.json"))
         if self.flight is not None:
             self.flight.dump("close")
             self.flight.uninstall()

@@ -180,7 +180,7 @@ class FlightRecorder:
                 if frozen:
                     os.unlink(tmp)
                     return None
-                os.replace(tmp, self.path)
+                os.replace(tmp, self.path)  # jaxlint: disable=JL402 -- self.path is per-process by construction: the telemetry facade names it flight_{process_index}.json, and the supervisor's flight_*.json harvest glob depends on exactly that naming
         except OSError:
             try:
                 os.unlink(tmp)

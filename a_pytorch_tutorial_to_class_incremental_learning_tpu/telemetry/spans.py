@@ -123,9 +123,17 @@ class SpanTracer:
 
     def export_chrome_trace(self, path: str) -> None:
         """Write the completed spans as ``chrome://tracing`` / Perfetto JSON
-        (complete-duration ``"X"`` events, microsecond timestamps)."""
+        (complete-duration ``"X"`` events, microsecond timestamps).
+
+        ``path`` is re-homed through ``process_suffixed`` (like the span
+        JSONL itself), so N processes exporting the same logical name never
+        race on one file: process 0 keeps ``trace.json``, process *i* writes
+        ``trace_p{i}.json``."""
         if not self.enabled:
             return
+        from ..utils.logging import process_suffixed
+
+        path = process_suffixed(path, self.process_index)
         events = [
             {
                 "name": rec["name"],

@@ -1,0 +1,732 @@
+"""fleetlint: interprocedural SPMD lockstep-discipline analysis (JL401-JL405).
+
+Stdlib-only, like the rest of jaxlint.  The SPMD contract this enforces:
+every process in a ``jax.distributed`` fleet must issue the *same* collective
+and global-pjit dispatches in the *same* order with the *same* shapes, and
+host-side artifacts shared across the fleet must have exactly one writer
+(process 0) or per-process names (``utils.logging.process_suffixed``).  A
+process that branches away from that contract does not crash — the whole pod
+silently hangs at the next collective, which is strictly worse.
+
+The model, reusing the ``threads.py`` machinery style:
+
+* **Fleet-aware modules.**  JL401/JL402 only fire in modules whose
+  identifiers mention the multi-process world (``process_index``,
+  ``is_main_process``, ``barrier``, ``distributed``, ...).  A single-process
+  script writing a file is not a fleet hazard.
+* **Divergent conditions.**  A branch test is *process-divergent* when it
+  reads ``jax.process_index()`` / ``is_main_process()`` / ``host_id`` /
+  rank-like names, or the environment (``os.environ`` / ``getenv``) — the
+  canonical sources of per-process values.  ``process_count()`` is the same
+  on every process and is *not* divergent.
+* **Collective reachability (interprocedural).**  A function *reaches* a
+  collective when its transitive bare-name call closure contains
+  ``barrier`` / ``process_allgather`` / ``psum`` / ... — computed to a fixed
+  point over the whole project, so ``if is_main_process(): self._finalize()``
+  is flagged when ``_finalize`` barriers three calls down.
+* **Gated entries (interprocedural).**  A helper's entry is *process-0
+  gated* when **every** project call site is lexically under a divergent
+  branch or inside a caller whose entry is gated — the same
+  intersection-over-call-sites fixpoint as threadlint entry locksets.  This
+  is how ``_write_pickle_atomic`` (always called under
+  ``if is_main_process():``) stays clean without a lexical gate of its own.
+
+Rules (see README "Static analysis"):
+
+* JL401 — a collective (directly, or via a function that transitively issues
+  one) or a jitted program dispatched under a process-divergent branch: the
+  gated processes skip the collective, the rest wait forever.  Dispatching
+  *process-local* programs under a gate (the export path) is legal — only
+  lexical jit/step dispatch and collective reachability are flagged.
+* JL402 — a host write (``open(.., "w"/"x")``, ``os.replace``/``rename``,
+  ``mkdir``/``makedirs`` without ``exist_ok``, ``Path.write_text/bytes``)
+  on a path with no per-process suffix, at a site that is neither lexically
+  under a divergent gate nor inside a gated-entry function: N processes race
+  on one file.
+* JL403 — iteration over a ``set`` (or a dict built from one) whose order
+  feeds device computation or class/exemplar ordering: set order depends on
+  per-process string hashing (PYTHONHASHSEED), so processes silently
+  disagree.  ``sorted(...)`` is the fix and the exemption.
+* JL404 — host-local entropy (``time.time``, ``os.urandom``, ``uuid4``,
+  unseeded ``random.*``) flowing into RNG key derivation (``PRNGKey`` /
+  ``fold_in`` / ``seed=``) or into a jitted program: every process derives a
+  different value, and ``int(...)`` does not make it deterministic.
+* JL405 — a per-process-variable shape (``len(local_batch)``,
+  ``local_x.shape[0]``) fed to a jitted program without global-batch
+  normalization (``process_count`` / ``global``): each process compiles and
+  runs a different program.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+# Cross-process sync points: every process must issue these in lockstep.
+_COLLECTIVES = {
+    "barrier", "process_allgather", "psum", "pmean", "pmax", "pmin",
+    "all_gather", "all_reduce", "all_to_all", "broadcast_one_to_all",
+    "sync_global_devices", "global_array_from_host",
+}
+
+# Calls whose value differs per process (branching on one diverges the fleet).
+_DIVERGENT_CALLS = {
+    "process_index", "is_main_process", "host_id", "getenv", "is_dist_env",
+    "node_rank", "local_rank",
+}
+# Bare names conventionally holding a per-process value.
+_DIVERGENT_NAMES = {
+    "rank", "is_master", "is_main", "pidx", "proc_id", "process_id",
+    "process_index", "host_id", "local_rank",
+}
+# Same on every process — reading these does NOT diverge control flow.
+_NONDIVERGENT = {"process_count", "device_count", "num_processes"}
+
+# Identifiers that make a module fleet-aware (JL401/JL402 in scope).
+_FLEET_MARKERS = {
+    "process_index", "process_count", "is_main_process", "distributed",
+    "process_allgather", "barrier", "multihost", "process_suffixed",
+    "host_id", "broadcast_one_to_all",
+}
+
+# Substrings that mark a path expression as per-process (JL402 exempt).
+_SUFFIX_MARKERS = ("process_suffixed", "process_index", "host_id", "rank",
+                   "shard_id", "getpid")
+
+# Entropy sources for JL404 (full dotted names, plus unambiguous bare leafs).
+_ENTROPY_DOTTED = {
+    "time.time", "time.time_ns", "os.urandom", "uuid.uuid1", "uuid.uuid4",
+    "random.random", "random.randint", "random.randrange", "random.choice",
+    "random.getrandbits", "random.sample", "secrets.token_bytes",
+    "secrets.token_hex", "secrets.randbits",
+}
+_ENTROPY_BARE = {"urandom", "uuid1", "uuid4", "time_ns", "getrandbits",
+                 "token_bytes", "token_hex", "randbits"}
+
+# Name fragments marking a per-process-sized value (JL405).
+_LOCAL_SHAPE_RE = re.compile(r"local|shard|per_process|host_batch")
+# Tokens showing the shape was normalized to the global batch (JL405 exempt).
+_GLOBAL_NORM_RE = re.compile(r"process_count|num_processes|global")
+# Iterables whose order is class/exemplar ordering even without device calls.
+_ORDER_SENSITIVE_RE = re.compile(r"class|exemplar|herd|logit|label")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last(name: Optional[str]) -> str:
+    return (name or "").split(".")[-1]
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover  # jaxlint: disable=JL302 -- ast.unparse on synthetic/exotic nodes; an empty string just skips the textual heuristics
+        return ""
+
+
+def _walk_no_defs(body: Iterable[ast.AST]):
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def divergent_reason(test: ast.expr) -> Optional[str]:
+    """The per-process value ``test`` reads, or None when it is fleet-uniform."""
+    for sub in ast.walk(test):
+        name = None
+        if isinstance(sub, ast.Call):
+            name = _dotted(sub.func)
+            if _last(name) in _NONDIVERGENT:
+                continue
+            if _last(name) in _DIVERGENT_CALLS:
+                return f"{name}()"
+        elif isinstance(sub, (ast.Name, ast.Attribute)):
+            name = _dotted(sub)
+            if not name:
+                continue
+            if "environ" in name.split("."):
+                return name
+            if _last(name) in _DIVERGENT_NAMES:
+                return name
+    return None
+
+
+def _write_site(call: ast.Call) -> Optional[Tuple[str, ast.expr]]:
+    """(description, path-expression) when ``call`` writes a host path."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open" and len(call.args) >= 2 \
+            and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str) \
+            and any(c in call.args[1].value for c in "wx"):
+        return (f'open(..., "{call.args[1].value}")', call.args[0])
+    d = _dotted(f) or ""
+    if d in ("os.replace", "os.rename") and len(call.args) >= 2:
+        return (d, call.args[1])
+    if d in ("os.mkdir",) and call.args:
+        return (d, call.args[0])
+    if d == "os.makedirs" and call.args:
+        for kw in call.keywords:
+            if kw.arg == "exist_ok" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value:
+                return None
+        return (d, call.args[0])
+    if isinstance(f, ast.Attribute) and f.attr in ("write_text", "write_bytes"):
+        return (f"{_dotted(f) or f.attr}()", f.value)
+    return None
+
+
+class _ModuleFacts:
+    __slots__ = ("fleet_aware", "jax", "jitted")
+
+    def __init__(self, fleet_aware: bool, jax: bool, jitted: Set[str]) -> None:
+        self.fleet_aware = fleet_aware
+        self.jax = jax
+        self.jitted = jitted
+
+
+class FleetIndex:
+    """Name-keyed cross-module facts for the JL4xx rules.
+
+    * ``collective_reachers``: bare function name -> the collective its
+      transitive call closure issues (fixpoint over the project call graph).
+    * ``gated_entries``: functions every one of whose project call sites is
+      under a divergent branch or inside a gated caller (fixpoint with
+      optimistic initialization; a function with no visible call site is a
+      public entry and starts ungated).
+    * ``step_attrs``: attribute names bound to donating jit programs
+      anywhere (the trainer's global step programs).
+    """
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, _ModuleFacts] = {}
+        self.collective_reachers: Dict[str, str] = {}
+        self.gated_entries: Set[str] = set()
+        self.step_attrs: Set[str] = set()
+
+    @classmethod
+    def build(cls, modules: Iterable[Tuple[str, ast.Module]],
+              jitted_by_module: Dict[str, Set[str]],
+              step_attrs: Set[str]) -> "FleetIndex":
+        idx = cls()
+        idx.step_attrs = set(step_attrs)
+        mods = list(modules)
+        calls: Dict[str, Set[str]] = {}
+        reach: Dict[str, str] = {}
+        sites: Dict[str, List[Tuple[Optional[str], bool]]] = {}
+        defs: Set[str] = set()
+        for path, tree in mods:
+            idents: Set[str] = set()
+            imports_jax = False
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Name):
+                    idents.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    idents.add(node.attr)
+                elif isinstance(node, ast.alias):
+                    idents.add(node.name.split(".")[-1])
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    idents.add(node.name)
+                    defs.add(node.name)
+                if isinstance(node, ast.Import):
+                    imports_jax |= any(a.name.split(".")[0] in ("jax", "jaxlib")
+                                       for a in node.names)
+                elif isinstance(node, ast.ImportFrom):
+                    imports_jax |= (node.module or "").split(".")[0] in \
+                        ("jax", "jaxlib") or node.level > 0
+            idx.modules[path] = _ModuleFacts(
+                bool(idents & _FLEET_MARKERS), imports_jax,
+                set(jitted_by_module.get(path, ())))
+        for path, tree in mods:
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                callees = calls.setdefault(node.name, set())
+                for sub in _walk_no_defs(node.body):
+                    if isinstance(sub, ast.Call):
+                        leaf = _last(_dotted(sub.func))
+                        callees.add(leaf)
+                        if leaf in _COLLECTIVES and node.name not in reach:
+                            reach[node.name] = leaf
+            # Call sites in non-fleet-aware modules are single-process entry
+            # points (smoke scripts, bench): they cannot race the fleet, so
+            # they count as gated rather than stripping the callee's gate.
+            cls._collect_sites(tree, defs, sites,
+                               idx.modules[path].fleet_aware)
+        # Collective reachability, to a fixed point.
+        changed = True
+        while changed:
+            changed = False
+            for fn, callees in calls.items():
+                if fn in reach:
+                    continue
+                hit = next((c for c in callees if c in reach), None)
+                if hit is not None:
+                    reach[fn] = reach[hit]
+                    changed = True
+        idx.collective_reachers = reach
+        # Gated entries: optimistic init for functions with visible sites,
+        # then strip any function one of whose sites is reachable ungated.
+        gated = {fn for fn in sites if fn in defs}
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(gated):
+                ok = all(g or (caller is not None and caller in gated)
+                         for caller, g in sites[fn])
+                if not ok:
+                    gated.discard(fn)
+                    changed = True
+        idx.gated_entries = gated
+        return idx
+
+    @staticmethod
+    def _collect_sites(tree: ast.Module, defs: Set[str],
+                       sites: Dict[str, List[Tuple[Optional[str], bool]]],
+                       fleet_aware: bool = True) -> None:
+        def scan(stmts: Iterable[ast.stmt], encl: Optional[str],
+                 gated: bool) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan(st.body, st.name, False)
+                    continue
+                if isinstance(st, ast.ClassDef):
+                    scan(st.body, encl, gated)
+                    continue
+                if isinstance(st, ast.If):
+                    record_calls(st.test, encl, gated)
+                    inner = gated or divergent_reason(st.test) is not None
+                    scan(st.body, encl, inner)
+                    scan(st.orelse, encl, inner)
+                    continue
+                for block in ("body", "orelse", "finalbody"):
+                    if getattr(st, block, None):
+                        hdr = [getattr(st, a) for a in ("test", "iter")
+                               if getattr(st, a, None) is not None]
+                        for h in hdr:
+                            record_calls(h, encl, gated)
+                        scan(getattr(st, block), encl, gated)
+                if isinstance(st, ast.Try):
+                    for h in st.handlers:
+                        scan(h.body, encl, gated)
+                if not hasattr(st, "body"):
+                    record_calls(st, encl, gated)
+
+        def record_calls(node: ast.AST, encl: Optional[str], gated: bool) -> None:
+            for sub in _walk_no_defs([node]):
+                if isinstance(sub, ast.Call):
+                    leaf = _last(_dotted(sub.func))
+                    if leaf in defs:
+                        sites.setdefault(leaf, []).append(
+                            (encl, gated or not fleet_aware))
+
+        scan(tree.body, None, False)
+
+
+# --------------------------------------------------------------------------- #
+# JL401 + JL402: per-scope walk with divergence-gate context
+# --------------------------------------------------------------------------- #
+
+
+def _scopes(tree: ast.Module):
+    """Yield (scope-name-or-None, stmt-list) for the module body and every
+    function body (nested defs become their own scopes)."""
+    yield None, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node.body
+
+
+def _suffixed_names(tree: ast.Module) -> Set[str]:
+    """Dotted names (module-wide, flow-insensitive, to a fixed point) whose
+    assigned value carries a per-process path component."""
+    assigns: List[Tuple[List[str], ast.expr]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            tgts, val = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgts, val = [node.target], node.value
+        else:
+            continue
+        names = [n for n in (_dotted(t) for t in tgts) if n]
+        if names:
+            assigns.append((names, val))
+    suffixed: Set[str] = set()
+
+    def marked(val: ast.expr) -> bool:
+        text = _unparse(val)
+        if any(m in text for m in _SUFFIX_MARKERS):
+            return True
+        return any(n in suffixed
+                   for n in (_dotted(s) for s in ast.walk(val)
+                             if isinstance(s, (ast.Name, ast.Attribute))) if n)
+
+    changed = True
+    while changed:
+        changed = False
+        for names, val in assigns:
+            if any(n in suffixed for n in names):
+                continue
+            if marked(val):
+                suffixed.update(names)
+                changed = True
+    return suffixed
+
+
+def _path_is_suffixed(path_expr: ast.expr, suffixed: Set[str]) -> bool:
+    text = _unparse(path_expr)
+    if any(m in text for m in _SUFFIX_MARKERS):
+        return True
+    return any(n in suffixed
+               for n in (_dotted(s) for s in ast.walk(path_expr)
+                         if isinstance(s, (ast.Name, ast.Attribute))) if n)
+
+
+def run_fleet_rules(path: str, tree: ast.Module, fleet: FleetIndex,
+                    out: List[Finding]) -> None:
+    facts = fleet.modules.get(path)
+    if facts is None:
+        return
+    if facts.fleet_aware:
+        _run_jl401_jl402(path, tree, fleet, facts, out)
+    if facts.jax:
+        _run_jl403(path, tree, fleet, facts, out)
+        _run_jl404(path, tree, fleet, facts, out)
+        _run_jl405(path, tree, fleet, facts, out)
+
+
+def _run_jl401_jl402(path: str, tree: ast.Module, fleet: FleetIndex,
+                     facts: _ModuleFacts, out: List[Finding]) -> None:
+    suffixed = _suffixed_names(tree)
+    emitted: Set[Tuple[int, int, str]] = set()
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        key = (node.lineno, node.col_offset, rule)
+        if key not in emitted:
+            emitted.add(key)
+            out.append(Finding(path, node.lineno, node.col_offset, rule, msg))
+
+    def check_expr(node: ast.AST, gate: Optional[str], entry_gated: bool) -> None:
+        for sub in _walk_no_defs([node]):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func)
+            leaf = _last(name)
+            if gate is not None:
+                if leaf in _COLLECTIVES:
+                    emit("JL401", sub,
+                         f"collective `{name or leaf}(...)` is dispatched under "
+                         f"a branch on `{gate}`: the other processes never "
+                         "issue it and the fleet deadlocks — hoist the "
+                         "collective out of the process-divergent branch")
+                elif leaf in fleet.collective_reachers:
+                    emit("JL401", sub,
+                         f"`{name or leaf}(...)` transitively issues the "
+                         f"collective `{fleet.collective_reachers[leaf]}` but "
+                         f"is called under a branch on `{gate}`: the other "
+                         "processes never reach it and the fleet deadlocks — "
+                         "hoist the call or make the collective unconditional")
+                elif leaf in facts.jitted or (name or "") in facts.jitted \
+                        or (isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in fleet.step_attrs):
+                    emit("JL401", sub,
+                         f"jitted program `{name or leaf}` is dispatched under "
+                         f"a branch on `{gate}`: on a global mesh every "
+                         "process must dispatch it in lockstep — gate only "
+                         "process-local work, never a global program")
+            if gate is None and not entry_gated:
+                site = _write_site(sub)
+                if site is not None:
+                    desc, path_expr = site
+                    if not _path_is_suffixed(path_expr, suffixed):
+                        emit("JL402", sub,
+                             f"`{desc}` writes `{_unparse(path_expr)}` with no "
+                             "per-process suffix and no process-0 gate: every "
+                             "process races on one file — gate the write with "
+                             "is_main_process() or name it via "
+                             "process_suffixed(path, process_index)")
+
+    def scan(stmts: Iterable[ast.stmt], gate: Optional[str],
+             entry_gated: bool) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # visited as its own scope
+            if isinstance(st, ast.ClassDef):
+                scan(st.body, gate, entry_gated)
+                continue
+            if isinstance(st, ast.If):
+                check_expr(st.test, gate, entry_gated)
+                inner = gate or divergent_reason(st.test)
+                scan(st.body, inner, entry_gated)
+                scan(st.orelse, inner, entry_gated)
+                continue
+            handled_blocks = False
+            for attr in ("test", "iter"):
+                if getattr(st, attr, None) is not None:
+                    check_expr(getattr(st, attr), gate, entry_gated)
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    check_expr(item.context_expr, gate, entry_gated)
+            for block in ("body", "orelse", "finalbody"):
+                sub = getattr(st, block, None)
+                if sub:
+                    handled_blocks = True
+                    scan(sub, gate, entry_gated)
+            if isinstance(st, ast.Try):
+                for h in st.handlers:
+                    scan(h.body, gate, entry_gated)
+            if not handled_blocks:
+                check_expr(st, gate, entry_gated)
+
+    for scope_name, body in _scopes(tree):
+        entry_gated = scope_name is not None and \
+            scope_name in fleet.gated_entries
+        scan(body, None, entry_gated)
+
+
+# --------------------------------------------------------------------------- #
+# JL403: unsorted set/dict iteration feeding ordered computation
+# --------------------------------------------------------------------------- #
+
+
+def _set_expr(node: ast.expr, set_named: Set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and _last(_dotted(node.func)) == "set":
+        return True
+    name = _dotted(node)
+    return bool(name) and name in set_named
+
+
+def _run_jl403(path: str, tree: ast.Module, fleet: FleetIndex,
+               facts: _ModuleFacts, out: List[Finding]) -> None:
+    set_named: Set[str] = set()
+    dict_from_set: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            tgts, val = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgts, val = [node.target], node.value
+        else:
+            continue
+        names = [n for n in (_dotted(t) for t in tgts) if n]
+        if not names:
+            continue
+        if _set_expr(val, set_named):
+            set_named.update(names)
+        elif isinstance(val, ast.DictComp) and val.generators and \
+                _set_expr(val.generators[0].iter, set_named):
+            dict_from_set.update(names)
+
+    def iter_hazard(it: ast.expr) -> Optional[str]:
+        if _set_expr(it, set_named):
+            return "set"
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) \
+                and it.func.attr in ("keys", "values", "items"):
+            recv = _dotted(it.func.value)
+            if recv and recv in dict_from_set:
+                return "set-keyed dict"
+        name = _dotted(it)
+        if name and name in dict_from_set:
+            return "set-keyed dict"
+        return None
+
+    def body_feeds_device(body: Iterable[ast.stmt]) -> bool:
+        for sub in _walk_no_defs(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func) or ""
+            leaf = _last(name)
+            if name.startswith(("jnp.", "jax.")) or leaf in _COLLECTIVES \
+                    or leaf in facts.jitted or leaf in fleet.step_attrs \
+                    or leaf == "device_put":
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        kind = iter_hazard(node.iter)
+        if kind is None:
+            continue
+        text = _unparse(node.iter)
+        if not (body_feeds_device(node.body)
+                or _ORDER_SENSITIVE_RE.search(text.lower())):
+            continue
+        out.append(Finding(
+            path, node.iter.lineno, node.iter.col_offset, "JL403",
+            f"iteration over the {kind} `{text}` feeds device computation or "
+            "class ordering: set order depends on per-process string hashing, "
+            "so processes silently disagree — iterate `sorted(...)` instead",
+        ))
+    # list(<set>) captured into an order-bearing name is the same defect.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tname = _dotted(node.targets[0]) or ""
+        val = node.value
+        if isinstance(val, ast.Call) and _last(_dotted(val.func)) == "list" \
+                and val.args and _set_expr(val.args[0], set_named) \
+                and _ORDER_SENSITIVE_RE.search(tname.lower()):
+            out.append(Finding(
+                path, val.lineno, val.col_offset, "JL403",
+                f"`{tname} = list({_unparse(val.args[0])})` freezes a "
+                "per-process set order into a class/exemplar ordering — use "
+                "sorted(...) so every process agrees",
+            ))
+
+
+# --------------------------------------------------------------------------- #
+# JL404: host-local entropy into RNG keys / traced values
+# --------------------------------------------------------------------------- #
+
+
+def _run_jl404(path: str, tree: ast.Module, fleet: FleetIndex,
+               facts: _ModuleFacts, out: List[Finding]) -> None:
+    for scope_name, body in _scopes(tree):
+        tainted: Dict[str, str] = {}  # name -> entropy source description
+
+        def entropy_of(expr: ast.expr) -> Optional[str]:
+            for sub in _walk_no_defs([expr]):
+                if isinstance(sub, ast.Call):
+                    name = _dotted(sub.func) or ""
+                    if name in _ENTROPY_DOTTED or _last(name) in _ENTROPY_BARE:
+                        return f"{name}()"
+                elif isinstance(sub, (ast.Name, ast.Attribute)):
+                    name = _dotted(sub) or ""
+                    if name in tainted:
+                        return tainted[name]
+            return None
+
+        changed = True
+        while changed:  # flow-insensitive closure over scope assignments
+            changed = False
+            for node in _walk_no_defs(body):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                val = node.value if not isinstance(node, ast.Assign) \
+                    else node.value
+                if val is None:
+                    continue
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                src = entropy_of(val)
+                if src is None:
+                    continue
+                for t in tgts:
+                    name = _dotted(t)
+                    if name and name not in tainted:
+                        tainted[name] = src
+                        changed = True
+
+        for node in _walk_no_defs(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func) or ""
+            leaf = _last(name)
+            sink = None
+            if leaf in ("PRNGKey", "fold_in") or name.endswith("random.key"):
+                sink = f"`{name}` RNG key derivation"
+            elif leaf in facts.jitted or leaf in fleet.step_attrs:
+                sink = f"jitted program `{name}`"
+            elif leaf == "device_put" or name.startswith("jnp."):
+                sink = f"device value `{name}(...)`"
+            if sink is not None:
+                for arg in node.args:
+                    src = entropy_of(arg)
+                    if src is not None:
+                        out.append(Finding(
+                            path, arg.lineno, arg.col_offset, "JL404",
+                            f"host-local entropy from `{src}` flows into "
+                            f"{sink}: every process derives a different value "
+                            "and the fleet diverges — derive it from the "
+                            "seeded config key (fold_in) or broadcast from "
+                            "process 0",
+                        ))
+                        break
+            for kw in node.keywords:
+                if kw.arg in ("seed", "rng_seed", "key"):
+                    src = entropy_of(kw.value)
+                    if src is not None:
+                        out.append(Finding(
+                            path, kw.value.lineno, kw.value.col_offset, "JL404",
+                            f"host-local entropy from `{src}` used as "
+                            f"`{kw.arg}=`: every process seeds differently "
+                            "and the fleet diverges — use the configured "
+                            "seed, or broadcast one value from process 0",
+                        ))
+
+
+# --------------------------------------------------------------------------- #
+# JL405: per-process-variable shapes into global programs
+# --------------------------------------------------------------------------- #
+
+
+def _run_jl405(path: str, tree: ast.Module, fleet: FleetIndex,
+               facts: _ModuleFacts, out: List[Finding]) -> None:
+    for scope_name, body in _scopes(tree):
+        local_shape: Set[str] = set()
+        for node in _walk_no_defs(body):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            val = getattr(node, "value", None)
+            if val is None:
+                continue
+            text = _unparse(val)
+            if ("len(" in text or ".shape" in text) \
+                    and _LOCAL_SHAPE_RE.search(text.lower()) \
+                    and not _GLOBAL_NORM_RE.search(text.lower()):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                local_shape.update(n for n in (_dotted(t) for t in tgts) if n)
+
+        def per_process_shape(arg: ast.expr) -> Optional[str]:
+            text = _unparse(arg)
+            low = text.lower()
+            if _GLOBAL_NORM_RE.search(low):
+                return None
+            if ("len(" in text or ".shape" in text) \
+                    and _LOCAL_SHAPE_RE.search(low):
+                return text
+            for sub in ast.walk(arg):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    n = _dotted(sub)
+                    if n and n in local_shape:
+                        return n
+            return None
+
+        for node in _walk_no_defs(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func) or ""
+            leaf = _last(name)
+            if not (leaf in facts.jitted or name in facts.jitted
+                    or leaf in fleet.step_attrs):
+                continue
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                hit = per_process_shape(arg)
+                if hit is not None:
+                    out.append(Finding(
+                        path, arg.lineno, arg.col_offset, "JL405",
+                        f"per-process-variable shape `{hit}` is fed to the "
+                        f"global jitted program `{name}`: each process "
+                        "compiles and dispatches a different program and the "
+                        "fleet diverges — normalize to the global batch "
+                        "(e.g. multiply by process_count, or pad to a fixed "
+                        "global shape) before the jit boundary",
+                    ))

@@ -1,0 +1,288 @@
+"""Runtime cross-host lockstep sentinel behind ``--check_lockstep``.
+
+The SPMD contract fleetlint (JL401-JL405) checks statically is enforced here
+dynamically: before every train/eval program dispatch, each process
+fingerprints what it is *about* to dispatch — program name, argument
+shape/dtype signature, a CRC32 digest of the host batch, and the RNG
+derivation coordinates — publishes the fingerprint to a shared exchange
+directory, and compares it field-by-field against every peer's fingerprint
+for the same sequence number.  A divergent process is caught at the dispatch
+*boundary*, with a named record saying exactly which field disagrees, instead
+of the alternative: the whole pod silently hanging inside the next collective
+with nothing in any log.
+
+Failure surfaces, in order of preference:
+
+* **fingerprint mismatch** — a ``lockstep_violation`` record
+  (``kind="fingerprint_mismatch"``) naming the step and the divergent fields
+  with both values, a flight-recorder dump (``on_fatal``), then
+  :class:`LockstepViolation`.  Every live process detects the same mismatch
+  independently (comparison is symmetric), so *all* processes dump before
+  any of them would have entered the collective.
+* **peer timeout** — the exchange poll has a deadline; a dead or wedged peer
+  surfaces as ``kind="peer_timeout"`` naming the peer, not as a silent
+  stall.
+
+The exchange medium is a shared directory (the CPU test-cluster and
+single-host-multiprocess medium; on a real pod, point it at shared storage):
+process *i* atomically publishes ``p{i}/{seq:08d}.json`` and polls its peers
+for the same ``seq``.  Stdlib-only at import time (numpy is imported lazily
+inside :func:`data_digest`), mirroring ``analysis.threadcheck``.
+
+Wiring (``engine/loop.py``): the trainer builds one sentinel when
+``--check_lockstep`` is set, clears its own subdirectory, and ``barrier()``s
+before the first check so no process can read a stale file from a previous
+attempt.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "LockstepSentinel",
+    "LockstepViolation",
+    "arg_signature",
+    "data_digest",
+]
+
+# Fields compared across processes (everything except per-process identity).
+_COMPARED = ("unit", "program", "arg_sig", "digest", "rng", "step", "task",
+             "epoch")
+
+
+class LockstepViolation(RuntimeError):
+    """Processes are about to fall out of SPMD lockstep (or a peer died)."""
+
+
+def data_digest(*arrays: Any) -> str:
+    """CRC32 over the raw bytes of host arrays — cheap enough to run per
+    step, strong enough that two processes reading different batches
+    disagree immediately.  Accepts numpy arrays, things convertible to them,
+    and bytes."""
+    import numpy as np
+
+    crc = 0
+    for a in arrays:
+        if a is None:
+            continue
+        if isinstance(a, (bytes, bytearray, memoryview)):
+            buf = bytes(a)
+        else:
+            buf = np.ascontiguousarray(a).tobytes()
+        crc = zlib.crc32(buf, crc)
+    return f"{crc:08x}"
+
+
+def arg_signature(args: Sequence[Any]) -> str:
+    """``f32[128,32,32,3];i32[128]``-style shape/dtype signature.  Works on
+    anything with ``.shape``/``.dtype`` (jax or numpy arrays, committed or
+    not) without touching device data; scalars render as ``py:<type>``."""
+    parts: List[str] = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{dtype}[{','.join(str(int(d)) for d in shape)}]")
+        else:
+            parts.append(f"py:{type(a).__name__}")
+    return ";".join(parts)
+
+
+class LockstepSentinel:
+    """Pre-dispatch fingerprint exchange across a ``jax.distributed`` fleet.
+
+    ``check(...)`` is called immediately *before* each program dispatch.  In
+    single-process runs it only logs the fingerprint (provenance for the run
+    log); in multi-process runs it publishes and compares.  Violations
+    append to ``self.violations``, emit a ``lockstep_violation`` record,
+    call ``on_fatal`` (the flight recorder's fatal dump), and raise.
+    """
+
+    def __init__(
+        self,
+        exchange_dir: Optional[str],
+        process_index: int = 0,
+        process_count: int = 1,
+        *,
+        sink=None,
+        on_fatal=None,
+        deadline_s: float = 120.0,
+        poll_s: float = 0.02,
+    ) -> None:
+        self.exchange_dir = exchange_dir
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.sink = sink
+        self.on_fatal = on_fatal
+        self.deadline_s = float(deadline_s)
+        self.poll_s = float(poll_s)
+        self.violations: List[dict] = []
+        self._buffered: List[Tuple[str, dict]] = []
+        self._seq = 0
+        self._mydir: Optional[str] = None
+        if self.multi_process:
+            if not exchange_dir:
+                raise ValueError(
+                    "check_lockstep with process_count > 1 needs an exchange "
+                    "directory (--lockstep_dir, or a --telemetry_dir / "
+                    "--ckpt_dir to default under)")
+            self._mydir = os.path.join(exchange_dir,
+                                       f"p{self.process_index}")
+            # Clear own stale records from a previous attempt.  The trainer
+            # barriers after construction, so no peer can read a stale file
+            # once checks start.
+            if os.path.isdir(self._mydir):
+                for name in os.listdir(self._mydir):
+                    try:
+                        os.unlink(os.path.join(self._mydir, name))
+                    except OSError:
+                        pass
+            os.makedirs(self._mydir, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def multi_process(self) -> bool:
+        return self.process_count > 1
+
+    def bind_sink(self, sink) -> None:
+        """Attach the telemetry sink; records emitted before the sink existed
+        (none in the normal wiring order) flush through now."""
+        self.sink = sink
+        if sink is not None:
+            for rtype, payload in self._buffered:
+                sink.log(rtype, **payload)
+            self._buffered = []
+
+    def _log(self, rtype: str, payload: dict) -> None:
+        if self.sink is not None:
+            self.sink.log(rtype, **payload)
+        else:
+            self._buffered.append((rtype, payload))
+
+    # ------------------------------------------------------------------ #
+
+    def fingerprint(
+        self,
+        unit: str,
+        program: str,
+        args: Sequence[Any] = (),
+        digest: Optional[str] = None,
+        rng: Optional[Sequence[int]] = None,
+        step: Optional[int] = None,
+        task: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> dict:
+        fp: Dict[str, Any] = {
+            "unit": unit,
+            "program": program,
+            "arg_sig": arg_signature(args),
+            "digest": digest,
+            "rng": list(int(v) for v in rng) if rng is not None else None,
+            "step": step,
+            "task": task,
+            "epoch": epoch,
+            "seq": self._seq,
+            "process_index": self.process_index,
+        }
+        blob = json.dumps([fp[k] for k in _COMPARED], sort_keys=True)
+        fp["hash"] = hashlib.sha256(blob.encode()).hexdigest()[:16]
+        return fp
+
+    def check(self, unit: str, program: str, args: Sequence[Any] = (),
+              digest: Optional[str] = None,
+              rng: Optional[Sequence[int]] = None,
+              step: Optional[int] = None, task: Optional[int] = None,
+              epoch: Optional[int] = None) -> dict:
+        """Fingerprint one imminent dispatch, exchange, compare; raises
+        :class:`LockstepViolation` on divergence or peer death."""
+        fp = self.fingerprint(unit, program, args, digest, rng, step, task,
+                              epoch)
+        self._log("lockstep_fingerprint",
+                  {k: v for k, v in fp.items() if v is not None})
+        if self.multi_process:
+            self._publish(fp)
+            for peer in range(self.process_count):
+                if peer != self.process_index:
+                    self._compare(fp, peer)
+        self._seq += 1
+        return fp
+
+    # ------------------------------------------------------------------ #
+
+    def _publish(self, fp: dict) -> None:
+        path = os.path.join(self._mydir, f"{fp['seq']:08d}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(fp, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _read_peer(self, peer: int, seq: int) -> Optional[dict]:
+        path = os.path.join(self.exchange_dir, f"p{peer}", f"{seq:08d}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # not yet published, or mid-rename
+
+    def _compare(self, fp: dict, peer: int) -> None:
+        deadline = time.monotonic() + self.deadline_s
+        theirs: Optional[dict] = None
+        while time.monotonic() < deadline:
+            theirs = self._read_peer(peer, fp["seq"])
+            if theirs is not None:
+                break
+            time.sleep(self.poll_s)
+        if theirs is None:
+            self._violate({
+                "kind": "peer_timeout",
+                "peer": peer,
+                "unit": fp["unit"],
+                "seq": fp["seq"],
+                "deadline_s": self.deadline_s,
+                "step": fp["step"],
+                "task": fp["task"],
+                "epoch": fp["epoch"],
+                "program": fp["program"],
+            }, f"lockstep: process {peer} published no fingerprint for seq "
+               f"{fp['seq']} ({fp['unit']}) within {self.deadline_s:.0f}s — "
+               "peer dead or wedged")
+            return
+        fields = [k for k in _COMPARED if fp.get(k) != theirs.get(k)]
+        if fields:
+            self._violate({
+                "kind": "fingerprint_mismatch",
+                "peer": peer,
+                "unit": fp["unit"],
+                "seq": fp["seq"],
+                "fields": fields,
+                "mine": {k: fp.get(k) for k in fields},
+                "theirs": {k: theirs.get(k) for k in fields},
+                "step": fp["step"],
+                "task": fp["task"],
+                "epoch": fp["epoch"],
+                "program": fp["program"],
+            }, f"lockstep: seq {fp['seq']} ({fp['unit']}, step "
+               f"{fp['step']}) diverges from process {peer} on "
+               f"{', '.join(fields)}: "
+               + "; ".join(f"{k}: mine={fp.get(k)!r} "
+                           f"theirs={theirs.get(k)!r}" for k in fields))
+
+    def _violate(self, payload: dict, message: str) -> None:
+        payload = {k: v for k, v in payload.items() if v is not None}
+        self.violations.append(payload)
+        self._log("lockstep_violation", payload)
+        if self.on_fatal is not None:
+            try:
+                self.on_fatal(f"lockstep_{payload['kind']}")
+            except Exception:  # pragma: no cover  # jaxlint: disable=JL302 -- the flight dump is best-effort evidence; failing to dump must not mask the violation being raised right below
+                pass
+        raise LockstepViolation(message)

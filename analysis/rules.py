@@ -32,6 +32,11 @@ Rule catalog (see README "Static analysis"):
 * JL303–JL306 — interprocedural lock discipline (threadlint): lock-order
   inversion, blocking under a lock, inconsistent locksets, torn thread-side
   file writes.  Implemented in :mod:`analysis.threads`.
+* JL401–JL405 — interprocedural SPMD lockstep discipline (fleetlint):
+  collectives under process-divergent branches, unsuffixed multi-writer host
+  paths, hash-ordered set iteration feeding device/class order, host entropy
+  in RNG derivation, per-process shapes into global programs.  Implemented
+  in :mod:`analysis.fleet`.
 
 The donation pass is a light abstract interpreter: it tracks which local
 names/attributes are bound to donating callables (including builder
@@ -50,6 +55,7 @@ import ast
 from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 from .findings import Finding
+from .fleet import FleetIndex, run_fleet_rules
 from .threads import ThreadIndex, run_thread_rules
 
 RULES: Dict[str, str] = {
@@ -66,6 +72,11 @@ RULES: Dict[str, str] = {
     "JL304": "blocking call (result/get/join/wait/file I/O) while holding a lock",
     "JL305": "attribute accessed under inconsistent locksets across methods",
     "JL306": "thread-side truncate-write without the atomic tmp-rename idiom",
+    "JL401": "collective or jitted dispatch under process-divergent control flow",
+    "JL402": "host write to an unsuffixed shared path without a process-0 gate",
+    "JL403": "unsorted set/dict iteration order feeds device or class ordering",
+    "JL404": "host-local entropy flows into RNG key derivation or traced values",
+    "JL405": "per-process-variable shape fed to a global jitted program",
 }
 
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
@@ -142,6 +153,7 @@ class ProjectIndex:
         self.builders: Dict[str, FrozenSet[int]] = {}
         self.donating_attrs: Dict[str, Tuple[str, FrozenSet[int]]] = {}
         self.threads: ThreadIndex = ThreadIndex()
+        self.fleet: FleetIndex = FleetIndex()
 
     @classmethod
     def build(cls, modules: Iterable[Tuple[str, ast.Module]]) -> "ProjectIndex":
@@ -169,6 +181,11 @@ class ProjectIndex:
                 kind = idx.value_donating(val)
                 if kind is not None:
                     idx.donating_attrs[tgt.attr] = kind
+        idx.fleet = FleetIndex.build(
+            mods,
+            {path: _jitted_callable_names(tree, idx) for path, tree in mods},
+            set(idx.donating_attrs),
+        )
         return idx
 
     def value_donating(self, val: ast.AST) -> Optional[Tuple[str, FrozenSet[int]]]:
@@ -1070,4 +1087,5 @@ def run_rules(path: str, tree: ast.Module, index: ProjectIndex) -> List[Finding]
     run_thread_shared(path, tree, out)
     run_swallowed_errors(path, tree, out)
     run_thread_rules(path, tree, index.threads, out)
+    run_fleet_rules(path, tree, index.fleet, out)
     return out

@@ -54,6 +54,29 @@ SCHEMA = {
          "held": list},
         None,
     ),
+    # Lockstep sentinel (analysis/lockstep.py, --check_lockstep): one
+    # fingerprint record per imminent train/eval dispatch.  unit is the
+    # dispatch site (train_step/train_epoch_fused/eval_step/feature_step),
+    # hash covers the cross-process-compared fields; digest/rng/step/task/
+    # epoch are present when the site provides them (None fields are
+    # stripped before logging).
+    "lockstep_fingerprint": (
+        {"unit": str, "program": str, "seq": NUM, "hash": str},
+        {"arg_sig": str, "digest": str, "rng": list, "step": NUM,
+         "task": NUM, "epoch": NUM},
+        None,
+    ),
+    # A process observed the fleet diverging (or a peer dead) at a dispatch
+    # boundary.  kind is fingerprint_mismatch (fields/mine/theirs name the
+    # disagreement) or peer_timeout (deadline_s elapsed with no peer
+    # fingerprint); emitted on every live process before any collective
+    # could hang, alongside a flight-recorder fatal dump.
+    "lockstep_violation": (
+        {"kind": str, "unit": str, "seq": NUM, "peer": NUM},
+        {"fields": list, "mine": dict, "theirs": dict, "deadline_s": NUM,
+         "step": NUM, "task": NUM, "epoch": NUM, "program": str},
+        None,
+    ),
     # Prefetch producer death -> synchronous-path degradation
     # (data/prefetch.py on_degrade hook, wired in engine/loop.py).
     "prefetch_degraded": (

@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # One-shot CI: static analysis first (jaxlint, then ruff/mypy when they are
 # installed), telemetry-schema lint over the committed evidence logs, a CPU
-# prefetch determinism smoke, the chaos + serving smokes (single-server and replicated
-# fleet), the perf-regression gates (train step, serving p99, and fleet p99
+# prefetch determinism smoke, the chaos + lockstep + serving smokes (single-server
+# and replicated fleet), the perf-regression gates (train step, serving p99, and fleet p99
 # under overload), then the tier-1 test suite (the exact
 # ROADMAP.md command).  Run from anywhere:
 #
@@ -12,14 +12,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/12: jaxlint (JAX-hazard + lock-discipline static analysis) =="
+echo "== stage 1/13: jaxlint (JAX-hazard + lock-discipline static analysis) =="
 # Fails on any finding not in analysis/jaxlint_baseline.json, and
 # (--check-baseline) on any baseline entry that no longer matches a live
 # finding — suppressions must not rot.  After fixing or justifying
 # findings, refresh with: python scripts/jaxlint.py --write-baseline
 python scripts/jaxlint.py --check-baseline || exit 1
 
-echo "== stage 2/12: ruff + mypy (skipped when not installed) =="
+echo "== stage 2/13: ruff + mypy (skipped when not installed) =="
 # Configured in pyproject.toml; the container does not bake these in, so the
 # stage gates on availability instead of failing the whole run.
 if command -v ruff >/dev/null 2>&1; then
@@ -33,16 +33,16 @@ else
   echo "mypy not installed; skipping"
 fi
 
-echo "== stage 3/12: telemetry schema lint =="
+echo "== stage 3/13: telemetry schema lint =="
 python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
 
-echo "== stage 4/12: CPU prefetch smoke (depth 2 ≡ depth 0) =="
+echo "== stage 4/13: CPU prefetch smoke (depth 2 ≡ depth 0) =="
 # Two-task synthetic run on the per-batch step path at --prefetch_depth 2;
 # its accuracy matrix must match a depth-0 run exactly (the asynchronous
 # input pipeline's determinism guarantee, data/prefetch.py).
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/prefetch_smoke.py || exit 1
 
-echo "== stage 5/12: jaxlint self-test fixtures =="
+echo "== stage 5/13: jaxlint self-test fixtures =="
 # The linter must still *find* the hazards it exists for (incl. the PR 3
 # restore-aliasing regression); covered by tests/test_jaxlint.py in tier-1,
 # but a broken linter that silently passes everything would also pass stage 1,
@@ -76,9 +76,89 @@ with tempfile.TemporaryDirectory() as d:
         print("jaxlint failed to flag the restore-aliasing fixture")
         sys.exit(1)
 print("jaxlint flags the restore-aliasing fixture: OK")
+
+# fleetlint (JL401-405): one fixture per SPMD hazard with *exact* file:line:rule
+# expectations, plus a fixed twin that must lint clean — a linter that drifts
+# off the documented lines or starts flagging the corrected idioms fails here.
+import re
+
+FLEET_BAD = '''import os
+import time
+import jax
+import jax.numpy as jnp
+from parallel.dist import barrier, process_allgather
+
+step = jax.jit(lambda s, b: s)
+
+def helper_sync():
+    barrier()
+
+def train(state, local_batch, class_ids):
+    if jax.process_index() == 0:
+        barrier()                      # JL401 direct
+    if os.environ.get("RANK") == "0":
+        helper_sync()                  # JL401 transitive
+    with open("status.json", "w") as f:   # JL402
+        f.write("x")
+    classes = set(class_ids)
+    for c in classes:                  # JL403
+        state = step(state, jnp.full((1,), c))
+    seed = int(time.time())
+    key = jax.random.PRNGKey(seed)     # JL404
+    n = len(local_batch)
+    state = step(state, local_batch[:n])
+    out = step(state, n)               # JL405
+    return state, key, out
+'''
+EXPECT = {(14, "JL401"), (16, "JL401"), (17, "JL402"), (20, "JL403"),
+          (23, "JL404"), (25, "JL405"), (26, "JL405")}
+
+FLEET_OK = '''import jax
+import jax.numpy as jnp
+from parallel.dist import barrier, is_main_process
+from telemetry.process import process_suffixed
+
+step = jax.jit(lambda s, b: s)
+
+def train(state, local_batch, class_ids, config, out_dir):
+    barrier()
+    if is_main_process():
+        with open(out_dir + "/status.json", "w") as f:
+            f.write("x")
+    with open(process_suffixed(out_dir, jax.process_index()), "w") as f:
+        f.write("x")
+    for c in sorted(set(class_ids)):
+        state = step(state, jnp.full((1,), c))
+    key = jax.random.PRNGKey(config.seed)
+    global_n = len(local_batch) * jax.process_count()
+    out = step(state, global_n)
+    return state, key, out
+'''
+with tempfile.TemporaryDirectory() as d:
+    p = pathlib.Path(d, "fleet_bad.py")
+    p.write_text(FLEET_BAD)
+    proc = subprocess.run(
+        [sys.executable, "scripts/jaxlint.py", "--baseline", "none", str(p)],
+        capture_output=True, text=True)
+    got = {(int(m.group(1)), m.group(2))
+           for m in re.finditer(r":(\d+):\d+: (JL4\d\d) ", proc.stdout)}
+    if proc.returncode == 0 or got != EXPECT:
+        print(proc.stdout + proc.stderr)
+        print(f"fleetlint drifted: expected {sorted(EXPECT)}, got {sorted(got)}")
+        sys.exit(1)
+    ok = pathlib.Path(d, "fleet_ok.py")
+    ok.write_text(FLEET_OK)
+    proc = subprocess.run(
+        [sys.executable, "scripts/jaxlint.py", "--baseline", "none", str(ok)],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        print(proc.stdout + proc.stderr)
+        print("fleetlint flags the corrected SPMD idioms")
+        sys.exit(1)
+print("fleetlint flags all five SPMD hazards at the expected lines: OK")
 PY
 
-echo "== stage 6/12: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
+echo "== stage 6/13: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # A tiny synthetic run SIGKILLs itself mid-task (--fault_spec kill@task1.epoch2),
 # scripts/supervise.py relaunches it with --resume, and the completed run's
 # accuracy matrix must be bit-identical to its fault-free twin — the
@@ -88,7 +168,19 @@ echo "== stage 6/12: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # thread_violation records (analysis/threadcheck.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 
-echo "== stage 7/12: CPU serve smoke (export + hot-swap under fire) =="
+echo "== stage 7/13: CPU lockstep chaos (2-process seeded divergence) =="
+# A real 2-process jax.distributed CPU cluster under --check_lockstep
+# (analysis/lockstep.py): the clean run must fingerprint every dispatch on
+# both processes with zero violations, and a seeded single-process batch
+# perturbation must surface as a schema-valid lockstep_violation naming the
+# divergent field on BOTH processes — with flight-recorder dumps written —
+# *before* any collective hangs (tests/test_multihost.py).
+timeout -k 10 3400 env JAX_PLATFORMS=cpu python -m pytest \
+  "tests/test_multihost.py::test_two_process_cluster_trains_in_lockstep" \
+  "tests/test_multihost.py::test_lockstep_sentinel_catches_seeded_divergence" \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly -m '' || exit 1
+
+echo "== stage 8/13: CPU serve smoke (export + hot-swap under fire) =="
 # Train a tiny 2-task run with --export_dir, then serve the artifacts under
 # live traffic while hot-swapping task 0 -> 1 with an injected swap_ioerror:
 # the failed swap must degrade gracefully (keep serving task 0, emit
@@ -99,18 +191,18 @@ echo "== stage 7/12: CPU serve smoke (export + hot-swap under fire) =="
 # ThreadCheck sentinel and must emit zero thread_violation records.
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || exit 1
 
-echo "== stage 8/12: perf regression gate (bench.py vs BASELINE.json) =="
+echo "== stage 9/13: perf regression gate (bench.py vs BASELINE.json) =="
 # step_ms is hard-gated at +15% vs the committed bench_gate entry;
 # fetch_overhead_ms loosely (see scripts/perf_gate.py).  After a deliberate
 # perf change, refresh with: python scripts/perf_gate.py --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py || exit 1
 
-echo "== stage 9/12: serving perf gate (bench.py --serve vs BASELINE.json) =="
+echo "== stage 10/13: serving perf gate (bench.py --serve vs BASELINE.json) =="
 # Closed-loop p99 latency of the micro-batching server, gated at +15% vs
 # the serve_gate entry.  Refresh: python scripts/perf_gate.py --serve --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve || exit 1
 
-echo "== stage 10/12: fleet overload soak (replicas + SIGKILL + rolling swap) =="
+echo "== stage 11/13: fleet overload soak (replicas + SIGKILL + rolling swap) =="
 # The resilience-tier chaos smoke: three supervised replica subprocesses
 # behind the admission-controlled front end under live bursty two-priority
 # traffic.  One replica is SIGKILL'd mid-traffic (breaker eject -> supervised
@@ -121,14 +213,14 @@ echo "== stage 10/12: fleet overload soak (replicas + SIGKILL + rolling swap) ==
 # (serving/frontend.py, serving/replica.py, serving/health.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py --fleet || exit 1
 
-echo "== stage 11/12: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
+echo "== stage 12/13: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
 # High-priority p99 under bursty overload through the replicated front end,
 # gated at +15% vs the serve_overload_gate entry: shedding low-priority work
 # exists precisely to keep this number flat.  Refresh:
 # python scripts/perf_gate.py --serve-overload --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve-overload || exit 1
 
-echo "== stage 12/12: tier-1 tests =="
+echo "== stage 13/13: tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

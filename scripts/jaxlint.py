@@ -7,6 +7,7 @@ Usage:
     python scripts/jaxlint.py --write-baseline    # accept current findings
     python scripts/jaxlint.py --baseline none     # ignore the baseline
     python scripts/jaxlint.py --list-rules        # print the rule catalog
+    python scripts/jaxlint.py --format json       # machine-readable findings
 
 Exit codes: 0 = no findings outside the baseline; 1 = new findings (printed
 as ``path:line:col: RULE message``); 2 = usage error.  Stale baseline
@@ -51,6 +52,11 @@ def main(argv=None) -> int:
                         help="fail (exit 1) when a baseline entry no longer "
                         "matches any live finding, instead of only warning")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="'json' emits a stable machine-readable report "
+                        "(schema: version, counts, findings[{file, line, col, "
+                        "rule, message, suppressed}]) for report_run.py; the "
+                        "exit code still reflects new findings")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -77,6 +83,36 @@ def main(argv=None) -> int:
         return 0
 
     new, known, stale = baseline.split(findings)
+
+    if args.format == "json":
+        import json
+
+        known_keys = {f.key for f in known}
+        report = {
+            "version": 1,
+            "root": root,
+            "rules": dict(sorted(RULES.items())),
+            "counts": {"new": len(new), "baselined": len(known),
+                       "stale_baseline": len(stale)},
+            "findings": [
+                {
+                    "file": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "rule": f.rule,
+                    "message": f.message,
+                    "suppressed": f.key in known_keys,
+                }
+                for f in sorted(findings,
+                                key=lambda f: (f.path, f.line, f.col, f.rule))
+            ],
+            "stale_baseline": list(stale),
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+        if stale and args.check_baseline:
+            return 1
+        return 1 if new else 0
+
     for f in new:
         print(f.render())
     if known:

@@ -600,7 +600,80 @@ def diff_runs(path_a: str, path_b: str):
     )
 
 
-def main(run_path: str, second_path: str | None = None):
+def render_jaxlint(report_path: str) -> None:
+    """Static-analysis panel from ``jaxlint --format json`` output.
+
+    The lint report is run evidence like any other artifact: a report that
+    says "clean, N baselined" next to the accuracy table is the PR-review
+    answer to "did this run's code pass its own discipline checks".  Raises
+    ``ValueError`` on schema drift so CI notices a broken producer instead
+    of silently rendering nothing.
+    """
+    with open(report_path) as f:
+        rep = json.load(f)
+    for key in ("version", "counts", "findings"):
+        if key not in rep:
+            raise ValueError(
+                f"{report_path}: not a jaxlint --format json report "
+                f"(missing {key!r})"
+            )
+    counts = rep["counts"]
+    print(
+        f"## static analysis — {counts['new']} new, "
+        f"{counts['baselined']} baselined, "
+        f"{counts['stale_baseline']} stale baseline entr(y/ies)\n"
+    )
+    by_rule = defaultdict(int)
+    for f in rep["findings"]:
+        missing = {"file", "line", "rule", "message", "suppressed"} - set(f)
+        if missing:
+            raise ValueError(
+                f"{report_path}: finding missing field(s) {sorted(missing)}"
+            )
+        by_rule[f["rule"]] += 1
+    if by_rule:
+        print("| rule | findings | summary |")
+        print("|------|----------|---------|")
+        rules = rep.get("rules", {})
+        for rule in sorted(by_rule):
+            print(f"| {rule} | {by_rule[rule]} "
+                  f"| {rules.get(rule, '?')} |")
+        print()
+    new = [f for f in rep["findings"] if not f["suppressed"]]
+    for f in new:
+        print(f"- **{f['rule']}** {f['file']}:{f['line']}: {f['message']}")
+    if new:
+        print()
+
+
+def render_lockstep(by_type) -> None:
+    """SPMD lockstep panel: fingerprinted dispatches and any divergence."""
+    fps = by_type["lockstep_fingerprint"]
+    violations = by_type["lockstep_violation"]
+    if not fps and not violations:
+        return
+    units = defaultdict(int)
+    for fp in fps:
+        units[fp.get("unit", "?")] += 1
+    unit_s = ", ".join(f"{u}={n}" for u, n in sorted(units.items()))
+    print(f"## lockstep — {len(fps)} fingerprinted dispatch(es) "
+          f"({unit_s}), {len(violations)} violation(s)\n")
+    for v in violations:
+        fields = ", ".join(v.get("fields", [])) or "-"
+        where = (f"step {v['step']}" if v.get("step") is not None
+                 else f"seq {v.get('seq')}")
+        print(f"- **{v.get('kind', '?')}** at {where} "
+              f"({v.get('unit', '?')}, peer {v.get('peer', '?')}): "
+              f"divergent fields: {fields}")
+        if "mine" in v:
+            print(f"  mine: `{json.dumps(v['mine'], sort_keys=True)}` "
+                  f"theirs: `{json.dumps(v['theirs'], sort_keys=True)}`")
+    if violations:
+        print()
+
+
+def main(run_path: str, second_path: str | None = None,
+         jaxlint_path: str | None = None):
     if second_path and _is_run_log(load_records(second_path)):
         # Two run logs -> side-by-side diff.  A spans file has only span
         # records, so the old `report_run.py run.jsonl spans.jsonl` form
@@ -631,9 +704,12 @@ def main(run_path: str, second_path: str | None = None):
         print("(no completed tasks in this log)\n")
     render_stalls(by_type["epoch"])
     render_recompiles(by_type["recompile"], by_type["recompile_warning"])
+    render_lockstep(by_type)
     render_serve(by_type)
     render_hbm(by_type["hbm"])
     render_fleet(run_path)
+    if jaxlint_path:
+        render_jaxlint(jaxlint_path)
     if spans_path is None:
         candidate = os.path.join(os.path.dirname(run_path), "spans.jsonl")
         spans_path = candidate if os.path.exists(candidate) else None
@@ -643,8 +719,19 @@ def main(run_path: str, second_path: str | None = None):
 
 
 if __name__ == "__main__":
-    if len(sys.argv) < 2:
+    argv = sys.argv[1:]
+    jaxlint_path = None
+    if "--jaxlint" in argv:
+        i = argv.index("--jaxlint")
+        try:
+            jaxlint_path = argv[i + 1]
+        except IndexError:
+            sys.exit("--jaxlint needs a path (jaxlint --format json output)")
+        del argv[i:i + 2]
+    if not argv:
         sys.exit(
             "usage: report_run.py <run.jsonl> [spans.jsonl | other_run.jsonl]"
+            " [--jaxlint <jaxlint.json>]"
         )
-    main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
+    main(argv[0], argv[1] if len(argv) > 1 else None,
+         jaxlint_path=jaxlint_path)

@@ -1,0 +1,186 @@
+"""parallel/dist.py: init_distributed_mode env parsing and error paths,
+the process-0 printer, and the coordination-service barrier.
+
+``jax.distributed.initialize`` is always monkeypatched — these tests run
+single-process and only verify the *host-side bootstrap logic*: which env
+variables select explicit vs auto-detected initialization, when failures
+raise vs degrade, and that single-process runs never touch the process
+group.  The real 2-process handshake is covered by tests/test_multihost.py.
+"""
+
+import builtins
+import io
+import sys
+
+import pytest
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.parallel import dist
+
+_ALL_MARKERS = (
+    list(dist._EXPLICIT_COORD_VARS)
+    + list(dist._HOST_LIST_VARS)
+    + ["MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_NUM_NODES",
+       "JAX_NUM_PROCESSES", "NUM_PROCESSES", "JAX_PROCESS_ID", "PROCESS_ID"]
+)
+
+
+@pytest.fixture
+def clean_dist(monkeypatch):
+    """Reset dist's module state and env markers around each test.
+
+    init_distributed_mode mutates module globals and (via
+    setup_for_distributed) replaces builtins.print; without restoration a
+    single test here would silence every later test's output.
+    """
+    for var in _ALL_MARKERS:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(dist, "_dist_initialized", False)
+    monkeypatch.setattr(dist, "_printer_installed", False)
+    monkeypatch.setattr(builtins, "print", builtins.print)
+    calls = []
+
+    def fake_initialize(**kwargs):
+        calls.append(kwargs)
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", fake_initialize)
+    return calls
+
+
+def test_single_process_is_a_noop(clean_dist):
+    dist.init_distributed_mode()
+    assert clean_dist == []
+    assert dist._dist_initialized is False
+
+
+def test_explicit_jax_env_triplet(clean_dist, monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:9999")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    dist.init_distributed_mode()
+    assert clean_dist == [{
+        "coordinator_address": "10.0.0.1:9999",
+        "num_processes": 2,
+        "process_id": 1,
+    }]
+    assert dist._dist_initialized is True
+
+
+def test_generic_env_triplet(clean_dist, monkeypatch):
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.2:1234")
+    monkeypatch.setenv("NUM_PROCESSES", "4")
+    monkeypatch.setenv("PROCESS_ID", "3")
+    dist.init_distributed_mode()
+    assert clean_dist == [{
+        "coordinator_address": "10.0.0.2:1234",
+        "num_processes": 4,
+        "process_id": 3,
+    }]
+
+
+def test_jax_vars_shadow_generic_vars(clean_dist, monkeypatch):
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "wrong:1")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "right:2")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    dist.init_distributed_mode()
+    assert clean_dist[0]["coordinator_address"] == "right:2"
+
+
+def test_coordinator_without_ids_uses_autodetection(clean_dist, monkeypatch):
+    # Coordinator given but num_processes/process_id left to Cloud TPU / Slurm
+    # metadata: only the address may be passed through.
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.3:5678")
+    dist.init_distributed_mode()
+    assert clean_dist == [{"coordinator_address": "10.0.0.3:5678"}]
+
+
+def test_heuristic_markers_use_full_autodetection(clean_dist, monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host1,host2")
+    dist.init_distributed_mode()
+    assert clean_dist == [{}]
+
+
+def test_explicit_coordinator_failure_raises(clean_dist, monkeypatch):
+    # The user asked for multi-host by name; degrading to N independent
+    # single-process runs would silently duplicate training.
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.4:1")
+
+    def boom(**kwargs):
+        raise RuntimeError("coordination service unreachable")
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        dist.init_distributed_mode()
+
+
+def test_heuristic_marker_failure_degrades(clean_dist, monkeypatch):
+    # Heuristic-only markers (metadata that merely looks multi-host) degrade
+    # to single-process with a stderr note instead of killing the run.
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host1,host2")
+
+    def boom(**kwargs):
+        raise RuntimeError("backend already initialized")
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", boom)
+    err = io.StringIO()
+    monkeypatch.setattr(sys, "stderr", err)
+    dist.init_distributed_mode()
+    assert "multi-host init skipped" in err.getvalue()
+
+
+def test_second_call_does_not_reinitialize(clean_dist, monkeypatch):
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.5:1")
+    dist.init_distributed_mode()
+    dist.init_distributed_mode()
+    assert len(clean_dist) == 1
+
+
+def test_cpu_platform_enables_gloo_collectives(clean_dist, monkeypatch):
+    # jax 0.4.x CPU clients reject cross-process computations unless a
+    # collectives implementation is configured before backend creation —
+    # and the flag is NOT read from the environment, so the bootstrap must
+    # set it via jax.config.update.
+    jax = dist.jax
+    flag = "jax_cpu_collectives_implementation"
+    if flag not in jax.config.values:
+        pytest.skip("this jax has no CPU collectives flag")
+    prior = jax.config.values[flag]
+    assert "cpu" in str(jax.config.jax_platforms)  # pinned by conftest
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.6:1")
+    try:
+        dist.init_distributed_mode()
+        assert jax.config.values[flag] == "gloo"
+    finally:
+        jax.config.update(flag, prior)
+
+
+def test_barrier_is_noop_single_process(monkeypatch):
+    # Must not touch the coordination service or issue a device collective.
+    seen = []
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 1)
+    monkeypatch.setattr(
+        dist, "_barrier_seq", dist._barrier_seq, raising=True
+    )
+    before = dist._barrier_seq
+    dist.barrier()
+    assert dist._barrier_seq == before and seen == []
+
+
+def test_barrier_uses_coordination_service(monkeypatch):
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 2)
+    waited = []
+
+    class FakeClient:
+        def wait_at_barrier(self, barrier_id, timeout_in_ms, process_ids=None):
+            waited.append((barrier_id, timeout_in_ms))
+
+    from jax._src import distributed as jax_dist
+
+    monkeypatch.setattr(jax_dist.global_state, "client", FakeClient())
+    dist.barrier(timeout_s=2.0)
+    dist.barrier(timeout_s=2.0)
+    assert len(waited) == 2
+    ids = [w[0] for w in waited]
+    # Every use gets a fresh barrier id — a passed barrier cannot be re-waited.
+    assert len(set(ids)) == 2 and all(i.startswith("cil_barrier_") for i in ids)
+    assert waited[0][1] == 2000

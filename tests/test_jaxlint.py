@@ -1012,3 +1012,253 @@ def test_analysis_package_imports_without_jax():
     )
     assert proc.returncode == 0, proc.stderr
     assert int(proc.stdout.strip()) >= 7
+
+
+# --------------------------------------------------------------------------- #
+# JL401 — collective / jitted dispatch under process-divergent control flow
+# --------------------------------------------------------------------------- #
+
+
+def test_jl401_gated_collective_direct(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier
+
+        def save(state):
+            if jax.process_index() == 0:
+                barrier()
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL401", 7)]
+    assert "deadlock" in findings[0].message
+
+
+def test_jl401_transitive_collective_through_helper(tmp_path):
+    findings = run_lint(tmp_path, """
+        import os
+        from parallel.dist import barrier
+
+        def sync():
+            barrier()
+
+        def save(state):
+            if os.environ.get("RANK") == "0":
+                sync()
+    """)
+    # The flagged site is the *call* under the gate, not the helper body.
+    assert [(f.rule, f.line) for f in findings] == [("JL401", 10)]
+    assert "transitively" in findings[0].message
+
+
+def test_jl401_process_local_work_under_gate_is_clean(tmp_path):
+    # The export path: collectives run unconditionally, only host-local
+    # serialization is gated to process 0.  Nothing to flag.
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier, is_main_process
+
+        def export(state, blob):
+            barrier()
+            if is_main_process():
+                blob.append(state)
+    """)
+    assert "JL401" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# JL402 — host write to an unsuffixed shared path without a process-0 gate
+# --------------------------------------------------------------------------- #
+
+
+def test_jl402_unsuffixed_shared_write(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier
+
+        def checkpoint(state):
+            with open("status.json", "w") as f:
+                f.write("x")
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL402", 6)]
+    assert "race" in findings[0].message
+
+
+def test_jl402_process0_gate_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier
+
+        def checkpoint(state):
+            if jax.process_index() == 0:
+                with open("status.json", "w") as f:
+                    f.write("x")
+    """)
+    assert "JL402" not in rules_of(findings)
+
+
+def test_jl402_suffixed_path_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from telemetry.process import process_suffixed
+
+        def log_to(d):
+            with open(process_suffixed(d, jax.process_index()), "w") as f:
+                f.write("x")
+    """)
+    assert "JL402" not in rules_of(findings)
+
+
+def test_jl402_gated_entry_function_is_clean(tmp_path):
+    # A helper whose *every* call site sits under a process-0 gate is itself
+    # gated: its body writes without re-checking process_index.
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier, is_main_process
+
+        def write_manifest(path):
+            with open(path, "w") as f:
+                f.write("x")
+
+        def export(state, path):
+            if is_main_process():
+                write_manifest(path)
+    """)
+    assert "JL402" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# JL403 — unsorted set iteration feeding device / class ordering
+# --------------------------------------------------------------------------- #
+
+
+def test_jl403_set_iteration_feeds_device(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from parallel.dist import barrier
+
+        step = jax.jit(lambda s, c: s)
+
+        def replay(state, class_ids):
+            for c in set(class_ids):
+                state = step(state, jnp.full((1,), c))
+            return state
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL403", 9)]
+    assert "sorted" in findings[0].message
+
+
+def test_jl403_sorted_iteration_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from parallel.dist import barrier
+
+        step = jax.jit(lambda s, c: s)
+
+        def replay(state, class_ids):
+            for c in sorted(set(class_ids)):
+                state = step(state, jnp.full((1,), c))
+            return state
+    """)
+    assert "JL403" not in rules_of(findings)
+
+
+def test_jl403_frozen_class_order_from_set(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def build_order(labels):
+            class_order = list(set(labels))
+            return class_order
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL403", 5)]
+
+
+# --------------------------------------------------------------------------- #
+# JL404 — host-local entropy into RNG keys / traced values
+# --------------------------------------------------------------------------- #
+
+
+def test_jl404_wallclock_seed(tmp_path):
+    findings = run_lint(tmp_path, """
+        import time
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(int(time.time()))
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL404", 6)]
+    assert "time.time()" in findings[0].message
+
+
+def test_jl404_entropy_as_seed_kwarg(tmp_path):
+    findings = run_lint(tmp_path, """
+        import os
+        import jax
+
+        def shuffle(ds):
+            return ds.shuffle(1024, seed=int.from_bytes(os.urandom(4), "big"))
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL404", 6)]
+
+
+def test_jl404_config_seed_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def make_key(config):
+            key = jax.random.PRNGKey(config.seed)
+            return jax.random.fold_in(key, config.task_id)
+    """)
+    assert "JL404" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# JL405 — per-process-variable shapes into global jitted programs
+# --------------------------------------------------------------------------- #
+
+
+def test_jl405_local_len_into_jit(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier
+
+        step = jax.jit(lambda s, n: s)
+
+        def train(state, local_batch):
+            n = len(local_batch)
+            return step(state, n)
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("JL405", 9)]
+    assert "process_count" in findings[0].message
+
+
+def test_jl405_global_normalized_is_clean(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        from parallel.dist import barrier
+
+        step = jax.jit(lambda s, n: s)
+
+        def train(state, local_batch):
+            global_n = len(local_batch) * jax.process_count()
+            return step(state, global_n)
+    """)
+    assert "JL405" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# fleetlint dogfood regressions — the real findings stay fixed
+# --------------------------------------------------------------------------- #
+
+
+def test_dogfood_telemetry_shared_writes_stay_fixed():
+    """PRs must not reintroduce the unsuffixed shared-path writes fleetlint
+    found in the telemetry layer (spans export, flight recorder): suffixed
+    or reason-suppressed sites produce no JL402 today."""
+    pkg = f"{REPO}/a_pytorch_tutorial_to_class_incremental_learning_tpu"
+    findings = lint_paths(
+        [f"{pkg}/telemetry/spans.py", f"{pkg}/telemetry/flight.py"],
+        root=REPO,
+    )
+    assert [f for f in findings if f.rule == "JL402"] == []

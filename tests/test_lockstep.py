@@ -1,0 +1,224 @@
+"""Unit tests for the runtime lockstep sentinel (analysis/lockstep.py).
+
+Two sentinels sharing one exchange directory stand in for a 2-process
+fleet; threads stand in for processes (the sentinel is pure file exchange —
+nothing in it touches jax).  The real 2-process wiring is covered by
+tests/test_multihost.py.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from analysis.lockstep import (
+    LockstepSentinel,
+    LockstepViolation,
+    arg_signature,
+    data_digest,
+)
+
+pytestmark = pytest.mark.quick
+
+
+# --------------------------------------------------------------------- #
+# Fingerprint ingredients
+# --------------------------------------------------------------------- #
+
+
+def test_data_digest_discriminates_and_is_stable():
+    a = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    b = a.copy()
+    b[0, 0] += 1
+    assert data_digest(a) == data_digest(a.copy())
+    assert data_digest(a) != data_digest(b)
+    # Multi-array digest covers every operand; None operands are skipped.
+    assert data_digest(a, None, b) == data_digest(a, b)
+    assert data_digest(a, b) != data_digest(b, a)
+    assert data_digest(b"bytes") == data_digest(bytearray(b"bytes"))
+
+
+def test_data_digest_ignores_layout_not_values():
+    # A transposed view has different strides but the same logical bytes
+    # after ascontiguousarray — two processes reading the same batch through
+    # different layouts must not trip the sentinel.
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    assert data_digest(a.T) == data_digest(np.ascontiguousarray(a.T))
+
+
+def test_arg_signature_shapes_dtypes_and_scalars():
+    x = np.zeros((128, 32, 32, 3), np.float32)
+    y = np.zeros((128,), np.int32)
+    assert arg_signature((x, y)) == "float32[128,32,32,3];int32[128]"
+    assert arg_signature((1.5, "s")) == "py:float;py:str"
+    assert arg_signature(()) == ""
+
+
+# --------------------------------------------------------------------- #
+# Sentinel: single-process and construction
+# --------------------------------------------------------------------- #
+
+
+class _Sink:
+    def __init__(self):
+        self.records = []
+
+    def log(self, rtype, **fields):
+        self.records.append((rtype, fields))
+
+
+def test_single_process_logs_but_never_exchanges(tmp_path):
+    sink = _Sink()
+    s = LockstepSentinel(None, process_index=0, process_count=1, sink=sink)
+    fp = s.check("train_step", "step", args=(np.zeros(3),), step=1)
+    assert fp["seq"] == 0 and s._seq == 1
+    assert s.violations == []
+    types = [r[0] for r in sink.records]
+    assert types == ["lockstep_fingerprint"]
+    # No exchange dir was ever needed or touched.
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_multi_process_requires_exchange_dir():
+    with pytest.raises(ValueError, match="exchange"):
+        LockstepSentinel(None, process_index=0, process_count=2)
+
+
+def test_bind_sink_flushes_buffered_records():
+    s = LockstepSentinel(None)
+    s.check("train_step", "step", step=1)
+    sink = _Sink()
+    s.bind_sink(sink)
+    assert [r[0] for r in sink.records] == ["lockstep_fingerprint"]
+    s.check("train_step", "step", step=2)
+    assert len(sink.records) == 2
+
+
+def test_construction_clears_stale_own_records(tmp_path):
+    stale = tmp_path / "p0"
+    stale.mkdir()
+    (stale / "00000000.json").write_text("{}")
+    LockstepSentinel(str(tmp_path), process_index=0, process_count=2)
+    assert list(stale.iterdir()) == []
+
+
+# --------------------------------------------------------------------- #
+# Sentinel: 2-"process" exchange (threads over one shared dir)
+# --------------------------------------------------------------------- #
+
+
+def _pair(tmp_path, **kw):
+    mk = lambda i: LockstepSentinel(  # noqa: E731
+        str(tmp_path), process_index=i, process_count=2, sink=_Sink(),
+        deadline_s=kw.pop("deadline_s", 20.0), **kw,
+    )
+    return mk(0), mk(1)
+
+
+def _both(call0, call1):
+    """Run the two sentinels' checks concurrently; return their outcomes."""
+    out = [None, None]
+
+    def run(i, call):
+        try:
+            out[i] = ("ok", call())
+        except LockstepViolation as e:
+            out[i] = ("violation", e)
+
+    t = threading.Thread(target=run, args=(1, call1))
+    t.start()
+    run(0, call0)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    return out
+
+
+def test_matching_fingerprints_pass(tmp_path):
+    s0, s1 = _pair(tmp_path)
+    batch = np.arange(12, dtype=np.float32)
+    kw = dict(args=(batch,), digest=data_digest(batch), rng=(0, 0, 0),
+              step=1, task=0, epoch=1)
+    out = _both(lambda: s0.check("train_step", "step", **kw),
+                lambda: s1.check("train_step", "step", **kw))
+    assert out[0][0] == out[1][0] == "ok"
+    assert s0.violations == [] and s1.violations == []
+    assert out[0][1]["hash"] == out[1][1]["hash"]
+
+
+def test_digest_mismatch_raises_on_both_sides(tmp_path):
+    s0, s1 = _pair(tmp_path)
+    kw = dict(args=(np.zeros(4, np.float32),), rng=(0, 0, 0), step=3)
+    out = _both(
+        lambda: s0.check("train_step", "step", digest="aaaaaaaa", **kw),
+        lambda: s1.check("train_step", "step", digest="bbbbbbbb", **kw),
+    )
+    # Detection is symmetric: every live process sees the same divergence.
+    for i, s in ((0, s0), (1, s1)):
+        assert out[i][0] == "violation"
+        (v,) = s.violations
+        assert v["kind"] == "fingerprint_mismatch"
+        assert v["fields"] == ["digest"]
+        assert v["step"] == 3 and v["peer"] == 1 - i
+        assert "digest" in str(out[i][1])
+    assert s0.violations[0]["mine"] == s1.violations[0]["theirs"]
+
+
+def test_multiple_divergent_fields_all_named(tmp_path):
+    s0, s1 = _pair(tmp_path)
+    out = _both(
+        lambda: s0.check("train_step", "step", args=(np.zeros(4),), step=1),
+        lambda: s1.check("train_step", "step", args=(np.zeros(5),), step=2),
+    )
+    assert out[0][0] == out[1][0] == "violation"
+    (v,) = s0.violations
+    assert sorted(v["fields"]) == ["arg_sig", "step"]
+    assert v["mine"]["arg_sig"] != v["theirs"]["arg_sig"]
+
+
+def test_peer_timeout_names_the_dead_peer(tmp_path):
+    s0, _ = _pair(tmp_path, deadline_s=0.3)
+    with pytest.raises(LockstepViolation, match="process 1"):
+        s0.check("train_step", "step", step=1)
+    (v,) = s0.violations
+    assert v["kind"] == "peer_timeout" and v["peer"] == 1
+    assert v["deadline_s"] == 0.3
+
+
+def test_violation_emits_record_and_fatal_dump(tmp_path):
+    dumps = []
+    sink = _Sink()
+    s0 = LockstepSentinel(
+        str(tmp_path), process_index=0, process_count=2, sink=sink,
+        on_fatal=dumps.append, deadline_s=0.2,
+    )
+    with pytest.raises(LockstepViolation):
+        s0.check("eval_step", "eval", step=9)
+    assert dumps == ["lockstep_peer_timeout"]
+    types = [r[0] for r in sink.records]
+    assert types == ["lockstep_fingerprint", "lockstep_violation"]
+    rec = sink.records[1][1]
+    assert rec["kind"] == "peer_timeout" and rec["unit"] == "eval_step"
+
+
+def test_on_fatal_failure_does_not_mask_the_violation(tmp_path):
+    def boom(reason):
+        raise OSError("disk full while dying")
+
+    s0 = LockstepSentinel(
+        str(tmp_path), process_index=0, process_count=2, on_fatal=boom,
+        deadline_s=0.2,
+    )
+    with pytest.raises(LockstepViolation):
+        s0.check("train_step", "step")
+
+
+def test_seq_advances_and_peers_match_by_seq(tmp_path):
+    # Two rounds back-to-back: each check compares against the peer file for
+    # the SAME seq, so a stale round-1 file can never satisfy round 2.
+    s0, s1 = _pair(tmp_path)
+    for step in (1, 2):
+        out = _both(lambda: s0.check("train_step", "step", step=step),
+                    lambda: s1.check("train_step", "step", step=step))
+        assert out[0][0] == out[1][0] == "ok"
+    assert s0._seq == s1._seq == 2
+    assert (tmp_path / "p0" / "00000001.json").is_file()

@@ -25,6 +25,10 @@ import json, os, sys
 sys.path.insert(0, os.environ["CIL_REPO"])
 import jax
 jax.config.update("jax_platforms", "cpu")
+# Cross-process CPU computations need an explicit collectives backend
+# (the trainer path sets this in init_distributed_mode; workers call
+# jax.distributed.initialize directly).
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=os.environ["CIL_COORD"],
     num_processes=2,
@@ -38,6 +42,9 @@ cfg = CilConfig(
     data_set="synthetic10", num_bases=0, increment=5, backbone="resnet20",
     batch_size=4, num_epochs=2, eval_every_epoch=100, memory_size=40,
     lr=0.05, aa=None, color_jitter=0.0, seed=7,
+    # Acceptance gate for --check_lockstep: a healthy replicated run must
+    # fingerprint every dispatch and find zero divergence.
+    check_lockstep=True, lockstep_dir=os.environ["CIL_LOCKSTEP"],
 )
 trainer = CilTrainer(cfg)  # default mesh: all 8 global devices
 assert jax.process_count() == 2, jax.process_count()
@@ -51,6 +58,8 @@ print("RESULT" + json.dumps({
     "acc1s": result["acc1s"],
     "memory_labels": np.asarray(my).tolist(),
     "memory_checksum": int(np.asarray(mx, np.int64).sum()),
+    "lockstep_checks": trainer.lockstep._seq,
+    "lockstep_violations": trainer.lockstep.violations,
 }), flush=True, force=True)
 """
 
@@ -104,13 +113,21 @@ def _run_cluster(tmp_path, worker_src, extra_env=None, name="worker"):
 
 
 def test_two_process_cluster_trains_in_lockstep(tmp_path):
-    results = _run_cluster(tmp_path, _WORKER)
+    results = _run_cluster(
+        tmp_path, _WORKER,
+        extra_env={"CIL_LOCKSTEP": str(tmp_path / "lockstep")},
+    )
     # Replicated training state: identical accuracy histories and identical
     # herded memories on every process, with zero memory-sync communication.
     assert results[0]["acc1s"] == results[1]["acc1s"]
     assert results[0]["memory_labels"] == results[1]["memory_labels"]
     assert results[0]["memory_checksum"] == results[1]["memory_checksum"]
     assert len(results[0]["acc1s"]) == 2
+    # Lockstep sentinel: same number of fingerprinted dispatches on both
+    # processes (train steps + eval slices + herding calls), no violations.
+    assert results[0]["lockstep_checks"] == results[1]["lockstep_checks"] > 0
+    assert results[0]["lockstep_violations"] == []
+    assert results[1]["lockstep_violations"] == []
 
 
 _CKPT_WORKER = r"""
@@ -118,6 +135,10 @@ import hashlib, json, os, sys
 sys.path.insert(0, os.environ["CIL_REPO"])
 import jax
 jax.config.update("jax_platforms", "cpu")
+# Cross-process CPU computations need an explicit collectives backend
+# (the trainer path sets this in init_distributed_mode; workers call
+# jax.distributed.initialize directly).
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=os.environ["CIL_COORD"],
     num_processes=2,
@@ -191,3 +212,95 @@ def test_multihost_orbax_checkpoint_kill_and_resume(tmp_path):
         assert resumed[pid]["memory_labels"] == full[pid]["memory_labels"]
         assert resumed[pid]["memory_checksum"] == full[pid]["memory_checksum"]
         assert resumed[pid]["params_md5"] == full[pid]["params_md5"]
+
+
+_DIVERGE_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CIL_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+# Cross-process CPU computations need an explicit collectives backend
+# (the trainer path sets this in init_distributed_mode; workers call
+# jax.distributed.initialize directly).
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=os.environ["CIL_COORD"],
+    num_processes=2,
+    process_id=int(sys.argv[1]),
+)
+import numpy as np
+from analysis.lockstep import LockstepViolation
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import CilTrainer
+
+pid = int(sys.argv[1])
+cfg = CilConfig(
+    data_set="synthetic10", num_bases=0, increment=5, backbone="resnet20",
+    batch_size=4, num_epochs=1, eval_every_epoch=100, memory_size=40,
+    lr=0.05, aa=None, color_jitter=0.0, seed=7,
+    check_lockstep=True, lockstep_dir=os.environ["CIL_LOCKSTEP"],
+    telemetry_dir=os.environ["CIL_TELEMETRY"],
+    # Per-batch path: the perturbation rides the host decode hook, and the
+    # violation names the exact step (the fused path digests per task).
+    fused_epochs=False,
+)
+trainer = CilTrainer(cfg)
+if pid == 1:
+    # Seeded divergence: process 1 silently perturbs one pixel of every
+    # decoded train batch — the classic "one host's input pipeline went
+    # bad" failure that otherwise surfaces as a pod-wide hang (or worse,
+    # silently different replicated weights).
+    orig = trainer._decode
+    def _bad_decode(xb, **kw):
+        out = np.array(orig(xb, **kw))
+        out.flat[0] += 1
+        return out
+    trainer._decode = _bad_decode
+err = None
+try:
+    trainer.fit()
+except LockstepViolation as e:
+    err = str(e)
+assert err is not None, "divergent fleet trained to completion undetected"
+v = trainer.lockstep.violations[-1]
+# The flight recorder's fatal dump ran on THIS process before the raise —
+# i.e. before this process could have entered (and hung in) the collective.
+flight = os.path.join(os.environ["CIL_TELEMETRY"], f"flight_{pid}.json")
+print("RESULT" + json.dumps({
+    "pid": pid,
+    "error": err,
+    "violation": v,
+    "flight_dump": os.path.isfile(flight),
+    "flight_reason": json.load(open(flight))["reason"],
+}), flush=True, force=True)
+"""
+
+
+@pytest.mark.slow
+def test_lockstep_sentinel_catches_seeded_divergence(tmp_path):
+    """Acceptance gate (b): one process's batch stream is perturbed; BOTH
+    processes must emit a ``lockstep_violation`` naming the step and the
+    divergent field, dump flight recorders, and die loudly — before any
+    collective could hang."""
+    results = _run_cluster(
+        tmp_path,
+        _DIVERGE_WORKER,
+        extra_env={
+            "CIL_LOCKSTEP": str(tmp_path / "lockstep"),
+            "CIL_TELEMETRY": str(tmp_path / "telemetry"),
+        },
+        name="diverge",
+    )
+    for pid in (0, 1):
+        v = results[pid]["violation"]
+        assert v["kind"] == "fingerprint_mismatch"
+        assert v["fields"] == ["digest"], v
+        assert v["unit"] == "train_step" and v["step"] == 1
+        assert v["mine"]["digest"] != v["theirs"]["digest"]
+        assert v["peer"] == 1 - pid
+        assert results[pid]["flight_dump"]
+        assert results[pid]["flight_reason"] == "lockstep_fingerprint_mismatch"
+        assert "digest" in results[pid]["error"]
+    # Symmetric detection: the two processes report mirrored values.
+    assert (results[0]["violation"]["mine"]
+            == results[1]["violation"]["theirs"])

@@ -660,3 +660,164 @@ def test_schema_accepts_reconciled_fault_record():
     assert m.check_record(rec, "x") == []
     bad = dict(rec, reconciled="yes")
     assert any("reconciled" in e for e in m.check_record(bad, "x"))
+
+
+# --------------------------------------------------------------------------- #
+# telemetry schema: the lockstep sentinel record types (--check_lockstep)
+# --------------------------------------------------------------------------- #
+
+
+def test_schema_accepts_lockstep_records():
+    m = _load_script("check_telemetry_schema")
+    fp = {"type": "lockstep_fingerprint", "ts": 1.0, "unit": "train_step",
+          "program": "train_step_kd", "seq": 0, "hash": "a1b2c3d4e5f60718",
+          "arg_sig": "float32[8,32,32,3];int32[8]", "digest": "0a0b0c0d",
+          "rng": [0, 0, 0], "step": 1, "task": 0, "epoch": 1,
+          "process_index": 0, "process_count": 2}
+    assert m.check_record(fp, "x") == []
+    # Sites without a host batch strip digest/rng/step (None fields are
+    # dropped before logging): still valid.
+    lean = {"type": "lockstep_fingerprint", "ts": 2.0, "unit": "eval_step",
+            "program": "eval_step@known5", "seq": 7, "hash": "ff00ff00ff00ff00"}
+    assert m.check_record(lean, "x") == []
+    mismatch = {"type": "lockstep_violation", "ts": 3.0,
+                "kind": "fingerprint_mismatch", "unit": "train_step",
+                "seq": 4, "peer": 1, "fields": ["digest"],
+                "mine": {"digest": "aa"}, "theirs": {"digest": "bb"},
+                "step": 5, "task": 0, "epoch": 1, "program": "train_step"}
+    assert m.check_record(mismatch, "x") == []
+    timeout = {"type": "lockstep_violation", "ts": 4.0,
+               "kind": "peer_timeout", "unit": "train_epoch_fused",
+               "seq": 9, "peer": 1, "deadline_s": 120.0,
+               "program": "epoch_fn"}
+    assert m.check_record(timeout, "x") == []
+
+
+def test_schema_rejects_malformed_lockstep_records():
+    m = _load_script("check_telemetry_schema")
+    # The fingerprint hash is the cross-process comparison key: required.
+    assert any("hash" in e for e in m.check_record(
+        {"type": "lockstep_fingerprint", "ts": 1.0, "unit": "train_step",
+         "program": "train_step", "seq": 0}, "x"))
+    # A violation must name its peer, and invents no fields.
+    assert any("peer" in e for e in m.check_record(
+        {"type": "lockstep_violation", "ts": 1.0, "kind": "peer_timeout",
+         "unit": "train_step", "seq": 0}, "x"))
+    assert any("divergence" in e for e in m.check_record(
+        {"type": "lockstep_violation", "ts": 1.0, "kind": "fingerprint_mismatch",
+         "unit": "train_step", "seq": 0, "peer": 1, "divergence": "digest"},
+        "x"))
+    # mine/theirs are field->value dicts, not strings.
+    assert any("mine" in e for e in m.check_record(
+        {"type": "lockstep_violation", "ts": 1.0, "kind": "fingerprint_mismatch",
+         "unit": "train_step", "seq": 0, "peer": 1, "mine": "aa"}, "x"))
+
+
+# --------------------------------------------------------------------------- #
+# jaxlint --format json -> report_run.py static-analysis panel
+# --------------------------------------------------------------------------- #
+
+
+def test_jaxlint_json_schema_and_exit_codes(tmp_path):
+    jaxlint = _load_script("jaxlint")
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return jnp.sum(x)\n"
+    )
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = jaxlint.main([str(src), "--baseline", "none", "--format", "json"])
+    rep = json.loads(out.getvalue())
+    assert rc == 0
+    assert rep["version"] == 1
+    assert rep["counts"] == {"new": 0, "baselined": 0, "stale_baseline": 0}
+    assert rep["findings"] == []
+    assert "JL401" in rep["rules"] and "JL405" in rep["rules"]
+
+    # A real finding: non-zero exit, and the finding serialized with the
+    # stable field set report_run.py consumes.
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "import time\n"
+        "def f(x):\n"
+        "    return jax.random.PRNGKey(int(time.time()))\n"
+    )
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = jaxlint.main([str(bad), "--baseline", "none", "--format", "json"])
+    rep = json.loads(out.getvalue())
+    assert rc == 1
+    assert rep["counts"]["new"] >= 1
+    f = rep["findings"][0]
+    assert set(f) == {"file", "line", "col", "rule", "message", "suppressed"}
+    assert f["rule"] == "JL404" and f["suppressed"] is False
+    assert f["line"] == 4
+
+
+def test_report_run_renders_jaxlint_panel(tmp_path):
+    report = tmp_path / "jaxlint.json"
+    report.write_text(json.dumps({
+        "version": 1,
+        "rules": {"JL402": "host write to an unsuffixed shared path "
+                           "without a process-0 gate"},
+        "counts": {"new": 1, "baselined": 2, "stale_baseline": 0},
+        "findings": [
+            {"file": "pkg/io.py", "line": 10, "col": 4, "rule": "JL402",
+             "message": "unsuffixed write", "suppressed": False},
+            {"file": "pkg/old.py", "line": 3, "col": 0, "rule": "JL402",
+             "message": "baselined write", "suppressed": True},
+        ],
+        "stale_baseline": [],
+    }))
+    m = _load_script("report_run")
+    out = io.StringIO()
+    with redirect_stdout(out):
+        m.render_jaxlint(str(report))
+    text = out.getvalue()
+    assert "1 new, 2 baselined" in text
+    assert "JL402" in text and "pkg/io.py:10" in text
+    # Suppressed findings are counted but not itemized.
+    assert "pkg/old.py" not in text
+
+
+def test_report_run_rejects_drifted_jaxlint_report(tmp_path):
+    import pytest
+
+    m = _load_script("report_run")
+    bad = tmp_path / "drifted.json"
+    bad.write_text(json.dumps({"version": 1, "counts": {}}))
+    with pytest.raises(ValueError, match="findings"):
+        m.render_jaxlint(str(bad))
+    bad.write_text(json.dumps({
+        "version": 1,
+        "counts": {"new": 1, "baselined": 0, "stale_baseline": 0},
+        "findings": [{"file": "a.py", "rule": "JL401"}],  # missing line/...
+    }))
+    with pytest.raises(ValueError, match="line"):
+        m.render_jaxlint(str(bad))
+
+
+def test_report_run_renders_lockstep_panel(tmp_path):
+    m = _load_script("report_run")
+    by_type = {
+        "lockstep_fingerprint": [
+            {"unit": "train_step", "seq": i, "hash": "ab"} for i in range(4)
+        ],
+        "lockstep_violation": [
+            {"kind": "fingerprint_mismatch", "unit": "train_step", "seq": 3,
+             "peer": 1, "fields": ["digest"], "mine": {"digest": "aa"},
+             "theirs": {"digest": "bb"}, "step": 4},
+        ],
+    }
+    out = io.StringIO()
+    with redirect_stdout(out):
+        m.render_lockstep(__import__("collections").defaultdict(list, by_type))
+    text = out.getvalue()
+    assert "4 fingerprinted dispatch(es)" in text
+    assert "1 violation(s)" in text
+    assert "fingerprint_mismatch" in text and "step 4" in text
+    assert "digest" in text
