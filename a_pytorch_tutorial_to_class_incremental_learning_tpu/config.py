@@ -194,6 +194,11 @@ class CilConfig:
     flight_events: int = 256  # flight-recorder ring capacity (0 = off);
     # the last N telemetry events are dumped to
     # <telemetry_dir>/flight_{proc}.json on every death path
+    metrics: bool = True  # time-series registry (telemetry/metrics.py):
+    # counters/gauges/histograms on the hot paths; --no_metrics swaps in
+    # no-op instruments (the off-leg of the perf_gate overhead comparison)
+    metrics_interval_s: float = 10.0  # MetricsPump flush cadence for
+    # metrics_snapshot records and the heartbeat progress digest
 
     # Serving (serving/ package: artifact export + hot-swap server)
     export_dir: Optional[str] = None  # after each task's weight alignment,
@@ -367,6 +372,15 @@ def get_args_parser() -> argparse.ArgumentParser:
                    help="flight-recorder ring capacity: the last N telemetry "
                    "events dumped to <telemetry_dir>/flight_{proc}.json on "
                    "crash/SIGTERM/exit for post-mortem forensics (0 = off)")
+    p.add_argument("--no_metrics", dest="metrics", action="store_false",
+                   default=True,
+                   help="disable the time-series metrics registry "
+                   "(telemetry/metrics.py); instruments become no-ops and "
+                   "no metrics_snapshot records are pumped")
+    p.add_argument("--metrics_interval_s", default=d.metrics_interval_s,
+                   type=float,
+                   help="metrics_snapshot flush cadence (and heartbeat "
+                   "progress-digest refresh) of the MetricsPump")
     p.add_argument("--bn_group_size", default=0, type=int,
                    help="BatchNorm statistics group size (0 = global batch; "
                    "128 = reference per-GPU parity)")
@@ -483,6 +497,8 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         heartbeat_path=args.heartbeat_path,
         heartbeat_interval_s=args.heartbeat_interval_s,
         flight_events=args.flight_events,
+        metrics=args.metrics,
+        metrics_interval_s=args.metrics_interval_s,
         export_dir=args.export_dir,
         serve_buckets=parse_serve_buckets(args.serve_buckets),
         serve_skew_check=args.serve_skew_check,

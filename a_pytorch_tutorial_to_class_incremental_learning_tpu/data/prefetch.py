@@ -78,12 +78,23 @@ class DevicePrefetcher:
         clock=None,
         name: str = "prefetch",
         on_degrade: Optional[Callable] = None,
+        metrics=None,
     ):
         self._source = iter(source)
         self._place = place if place is not None else (lambda batch: batch)
         self.depth = max(0, int(depth))
         self._clock = clock
         self._on_degrade = on_degrade
+        # Optional time-series hook (a MetricsRegistry / NullRegistry): the
+        # ring-occupancy level and the cumulative consumer-blocked time the
+        # fleet scraper reads between epoch records.
+        if metrics is None:
+            from ..telemetry.metrics import NullRegistry
+
+            metrics = NullRegistry()
+        self._m_wait_ms = metrics.counter("prefetch_wait_ms_total")
+        self._m_batches = metrics.counter("prefetch_batches_total")
+        self._m_occupancy = metrics.gauge("prefetch_occupancy")
         self._degraded = False
         self._fill_sum = 0
         self._gets = 0
@@ -173,9 +184,12 @@ class DevicePrefetcher:
         t0 = time.perf_counter()
         tag, payload = self._queue.get()
         # Only the non-overlapped residual is input-pipeline stall.
+        wait = time.perf_counter() - t0
         if self._clock is not None:
-            self._clock.add_host(time.perf_counter() - t0)
+            self._clock.add_host(wait)
+        self._m_wait_ms.inc(wait * 1e3)
         if tag == _BATCH:
+            self._m_batches.inc()
             return payload
         if tag == _DEGRADE:
             exc, host_batch = payload
@@ -244,6 +258,7 @@ class DevicePrefetcher:
         self._drain()
         if self._clock is not None and hasattr(self._clock, "set_prefetch"):
             self._clock.set_prefetch(self.depth, self.occupancy())
+        self._m_occupancy.set(self.occupancy())
 
     def _drain(self) -> None:
         if self._queue is None:

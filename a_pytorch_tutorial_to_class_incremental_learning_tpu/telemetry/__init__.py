@@ -29,6 +29,15 @@ from .cil_metrics import (  # noqa: F401
 from .counters import RecompileMonitor, StallClock, clocked, hbm_stats  # noqa: F401
 from .flight import FlightRecorder, FlightSink  # noqa: F401
 from .heartbeat import Heartbeat, read_heartbeat  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsPump,
+    MetricsRegistry,
+    NullRegistry,
+    histogram_quantile,
+    merge_histograms,
+    merge_snapshots,
+    snapshot_to_prometheus,
+)
 from .spans import SpanTracer, coverage, load_spans  # noqa: F401
 
 
@@ -62,6 +71,9 @@ class Telemetry:
         flight_events: int = 256,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        metrics: bool = True,
+        metrics_interval_s: float = 10.0,
+        metrics_source: str = "train",
     ):
         self.dir = telemetry_dir
         self.sink = sink or NullSink()
@@ -106,6 +118,24 @@ class Telemetry:
         )
         self.recompiles = RecompileMonitor(self.sink)
         self.matrix = AccuracyMatrix()
+        # Metrics plane: the registry is cheap enough to keep on by default
+        # (one shared lock; pre-resolved instruments); metrics=False swaps
+        # in no-op instruments so the hot path stays branch-free either way.
+        # The pump only runs when its output goes somewhere — a real sink
+        # (metrics_snapshot records) or an enabled heartbeat (progress
+        # digest for the supervisor's stall probe).
+        self.metrics = MetricsRegistry() if metrics else NullRegistry()
+        self.pump: Optional[MetricsPump] = None
+        real_sink = sink is not None and not isinstance(sink, NullSink)
+        if metrics and (self.heartbeat.enabled or real_sink):
+            self.pump = MetricsPump(
+                self.metrics,
+                self.sink,
+                interval_s=metrics_interval_s,
+                source=metrics_source,
+                heartbeat=self.heartbeat,
+            )
+            self.pump.start()
 
     @property
     def enabled(self) -> bool:
@@ -126,6 +156,10 @@ class Telemetry:
         Perfetto-compatible trace next to the span JSONL, and leave a final
         flight dump (then unhook the death paths, so tests that build many
         Telemetry objects in one process don't stack handlers)."""
+        if self.pump is not None:
+            # Final metrics flush (and heartbeat digest) before the
+            # heartbeat writes its last beat below.
+            self.pump.stop()
         self.heartbeat.stop()
         if self.spans.enabled:
             # export_chrome_trace applies process_suffixed itself: process 0

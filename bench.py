@@ -260,7 +260,113 @@ def bench_fused_epoch(trainer, iters: int, fused_n: int):
     return steps_per_epoch * bs / epoch_dt, epoch_dt
 
 
-def measure_step_path(batch_size: int, epochs: int, depths, steps_cap: int) -> dict:
+def _bind_trainer_metrics(trainer, registry) -> None:
+    """Rebind every step-path instrument handle to ``registry``.
+
+    The trainer caches its counter/histogram handles at init and the
+    prefetcher reads ``telemetry.metrics`` at construction, so swapping the
+    facade attribute plus the cached handles is a complete on/off toggle —
+    the compiled step itself is untouched.
+    """
+    trainer.telemetry.metrics = registry
+    trainer._m_steps = registry.counter("steps_total")
+    trainer._m_step_ms = registry.histogram(
+        "step_latency_ms", lowest=0.5, growth=2.0, buckets=18
+    )
+    trainer._m_epochs = registry.counter("epochs_total")
+    trainer._m_stall = registry.gauge("stall_frac")
+    trainer._m_recompiles = registry.gauge("recompiles_total")
+
+
+def measure_metrics_overhead(batch_size: int = 64, epochs: int = 2,
+                             steps_cap: int = 8, passes: int = 3) -> dict:
+    """Registry-on vs registry-off cost of the metrics plane on the hot path.
+
+    Runs the identical compiled per-step epoch with the live
+    ``MetricsRegistry`` (one counter inc + one histogram observe per step,
+    plus the prefetcher's wait/batch counters) and with the branch-free
+    ``NullRegistry``, alternating on/off passes so slow drift on a shared
+    host hits both modes equally, and taking the per-mode *minimum* wall
+    time (min-of-passes is robust to scheduler noise in a way means are
+    not).  ``perf_gate.py --metrics-overhead`` fails the build if
+    ``overhead_frac`` exceeds its gate (3%): observability must stay
+    effectively free or it gets turned off in production runs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.data.scenario import (
+        TaskSet,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine import CilTrainer
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+        StallClock,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        NullRegistry,
+    )
+
+    trainer = CilTrainer(
+        CilConfig(
+            data_set="synthetic",
+            num_bases=50,
+            increment=10,
+            backbone="resnet32",
+            batch_size=batch_size,
+            fused_epochs=False,
+            seed=0,
+        ),
+        init_dist=False,
+    )
+    trainer.state = trainer._grow_state(trainer.state, 0, 0, 50)
+    task = trainer.scenario_train[0]
+    n = min(len(task), steps_cap * trainer.global_batch_size)
+    task = TaskSet(x=task.x[:n], y=task.y[:n], t=task.t[:n])
+    steps = max(1, -(-n // trainer.global_batch_size))
+    epoch_key = jax.random.fold_in(trainer.root_key, 0)
+    state0 = jax.tree_util.tree_map(jnp.copy, trainer.state)
+
+    def run_pass():
+        trainer.state = jax.tree_util.tree_map(jnp.copy, state0)
+        clock = StallClock()
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            trainer._run_epoch_steps(0, task, 0, epoch_key, 0.1, 0.5, clock)
+        return time.perf_counter() - t0
+
+    registries = {"on": MetricsRegistry(), "off": NullRegistry()}
+    _bind_trainer_metrics(trainer, registries["on"])
+    run_pass()  # warmup: compile once, outside every timing
+    walls = {"on": [], "off": []}
+    for _ in range(max(1, passes)):
+        for mode in ("on", "off"):
+            _bind_trainer_metrics(trainer, registries[mode])
+            walls[mode].append(run_pass())
+    total_steps = steps * epochs
+    step_ms = {
+        mode: min(ws) / total_steps * 1e3 for mode, ws in walls.items()
+    }
+    overhead = step_ms["on"] / step_ms["off"] - 1.0
+    return {
+        "metric": "metrics_overhead",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "overhead_frac": round(overhead, 4),
+        "step_ms_on": round(step_ms["on"], 3),
+        "step_ms_off": round(step_ms["off"], 3),
+        "passes": passes,
+        "epochs_per_pass": epochs,
+        "steps_per_epoch": steps,
+        "global_batch": trainer.global_batch_size,
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+    }
+
+
+def measure_step_path(batch_size: int, epochs: int, depths, steps_cap: int,
+                      metrics: str = "on") -> dict:
     """Per-step-path benchmark: the same epoch at several prefetch depths.
 
     Runs ``CilTrainer._run_epoch_steps`` — the real per-batch training path,
@@ -298,6 +404,12 @@ def measure_step_path(batch_size: int, epochs: int, depths, steps_cap: int) -> d
     )
     # Task-0 head (50 classes), no teacher: the plain-CE step variant.
     trainer.state = trainer._grow_state(trainer.state, 0, 0, 50)
+    if metrics == "off":
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (
+            NullRegistry,
+        )
+
+        _bind_trainer_metrics(trainer, NullRegistry())
     task = trainer.scenario_train[0]
     n = min(len(task), steps_cap * trainer.global_batch_size)
     task = TaskSet(x=task.x[:n], y=task.y[:n], t=task.t[:n])
@@ -377,9 +489,14 @@ def measure_serve(duration_s: float = 4.0, workers: int = 8,
       or not earlier ones finished, the shape that exposes queueing delay a
       closed loop hides; percentiles come from the per-request latencies.
 
-    The headline ``value`` is closed-loop req/s; ``p99_ms`` (closed-loop,
-    per-request latencies after the ramp) is what ``perf_gate.py --serve``
-    gates.
+    The headline ``value`` is closed-loop req/s.  Two p99s come out: the
+    exact ``p99_ms`` from the per-request sample list (ramp excluded), and
+    ``hist_p99_ms`` scraped from the server's own
+    ``serve_batch_latency_ms`` registry histograms — the same series the
+    fleet scraper reads off ``/metrics``.  ``perf_gate.py --serve`` gates
+    on the scraped histogram when the baseline recorded one (quantized to
+    the exponential ladder, so the gate is rung-based), falling back to
+    the exact samples otherwise.
     """
     import shutil
     import tempfile
@@ -393,6 +510,12 @@ def measure_serve(duration_s: float = 4.0, workers: int = 8,
     from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
         create_model,
         grow,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        _split_series,
+        histogram_quantile,
+        merge_histograms,
     )
     from serving import InferenceServer, export_artifact
 
@@ -411,7 +534,9 @@ def measure_serve(duration_s: float = 4.0, workers: int = 8,
             input_size=32, channels=3, buckets=buckets,
         )
         export_s = time.perf_counter() - t0
-        server = InferenceServer(export_dir, max_wait_ms=max_wait_ms).start()
+        registry = MetricsRegistry()
+        server = InferenceServer(export_dir, max_wait_ms=max_wait_ms,
+                                 metrics=registry).start()
         try:
             rng = np.random.RandomState(0)
             img = rng.randint(0, 256, (32, 32, 3)).astype(np.uint8)
@@ -449,6 +574,21 @@ def measure_serve(duration_s: float = 4.0, workers: int = 8,
                 [ms for lats in lat_per_worker for ms in lats], np.float64
             )
             closed_stats = server.stats()
+            # The scraped view of the same window: per-bucket latency
+            # histograms off the server's registry (warmup + ramp included
+            # — cumulative series, exactly what /metrics would expose).
+            hist_p99 = None
+            hist_growth = None
+            lat_hists = [
+                h for k, h in registry.snapshot()["histograms"].items()
+                if _split_series(k)[0] == "serve_batch_latency_ms"
+            ]
+            if lat_hists:
+                merged_hist = lat_hists[0]
+                for h in lat_hists[1:]:
+                    merged_hist = merge_histograms(merged_hist, h)
+                hist_p99 = round(histogram_quantile(merged_hist, 0.99), 3)
+                hist_growth = merged_hist["growth"]
 
             # Open loop: fixed arrival clock, latencies from the responses.
             futs = []
@@ -473,6 +613,8 @@ def measure_serve(duration_s: float = 4.0, workers: int = 8,
             "p50_ms": round(float(np.percentile(closed_lat, 50)), 3),
             "p95_ms": round(float(np.percentile(closed_lat, 95)), 3),
             "p99_ms": round(float(np.percentile(closed_lat, 99)), 3),
+            "hist_p99_ms": hist_p99,
+            "hist_growth": hist_growth,
             "open_rps": open_rps,
             "open_p50_ms": round(float(np.percentile(open_lat, 50)), 3),
             "open_p99_ms": round(float(np.percentile(open_lat, 99)), 3),
@@ -516,9 +658,11 @@ def measure_serve_overload(duration_s: float = 6.0, buckets=(1, 8),
     Each request is ``high`` priority with probability ``high_frac``, else
     ``low``.  Reported per class: p50/p95/p99 of *successful* requests,
     shed rate (HTTP 503 at admission), and errors (anything else — a
-    healthy fleet reports zero).  ``p99_high_ms`` is what
-    ``perf_gate.py --serve-overload`` gates: the whole point of shedding
-    low first is that the high-class tail stays flat through overload.
+    healthy fleet reports zero).  ``perf_gate.py --serve-overload`` gates
+    the high-class tail — ``hist_p99_high_ms`` scraped from the front
+    end's registry when the baseline recorded one, the exact
+    ``p99_high_ms`` otherwise: the whole point of shedding low first is
+    that the high-class tail stays flat through overload.
     """
     import math
     import shutil
@@ -534,6 +678,11 @@ def measure_serve_overload(duration_s: float = 6.0, buckets=(1, 8),
     from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
         create_model,
         grow,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        _split_series,
+        histogram_quantile,
     )
     from serving import export_artifact
     from serving.frontend import Frontend
@@ -557,10 +706,12 @@ def measure_serve_overload(duration_s: float = 6.0, buckets=(1, 8),
                           max_wait_ms=max_wait_ms).start()
             for i in range(int(replicas))
         ]
+        registry = MetricsRegistry()
         frontend = Frontend(
             [(r.host, r.port) for r in fleet],
             capacity=int(capacity),
             default_deadline_ms=10000.0,
+            metrics=registry,
         ).start()
 
         rng = np.random.RandomState(seed)
@@ -624,6 +775,17 @@ def measure_serve_overload(duration_s: float = 6.0, buckets=(1, 8),
         pool.shutdown(wait=True)
         wall = time.perf_counter() - t_start
         fe_stats = frontend.stats()
+        # Scraped high-class tail: the front end's own fe_latency_ms
+        # histogram for priority=high — the series the fleet scraper and
+        # the rung-based overload gate consume.
+        hist_p99_high = None
+        hist_growth = None
+        for k, h in registry.snapshot()["histograms"].items():
+            name, labels = _split_series(k)
+            if name == "fe_latency_ms" and 'priority="high"' in labels:
+                hist_p99_high = round(histogram_quantile(h, 0.99), 3)
+                hist_growth = h["growth"]
+                break
 
         by_class = {}
         errors = 0
@@ -653,6 +815,8 @@ def measure_serve_overload(duration_s: float = 6.0, buckets=(1, 8),
             "value": by_class["high"]["p99_ms"],
             "unit": "ms",
             "p99_high_ms": by_class["high"]["p99_ms"],
+            "hist_p99_high_ms": hist_p99_high,
+            "hist_growth": hist_growth,
             "pattern": pattern,
             "rps": rps,
             "achieved_rps": round(sent / max(wall, 1e-9), 1),
@@ -804,7 +968,7 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
          serve_buckets=(1, 8, 32), serve_max_wait_ms: float = 3.0,
          serve_pattern=None, serve_rps: float = 120.0,
          serve_replicas: int = 2, serve_high_frac: float = 0.3,
-         serve_capacity: int = 24):
+         serve_capacity: int = 24, metrics: str = "on"):
     """``batch_size`` defaults to 512 — the reference's *global* batch
     (4 GPUs x 128), which fits comfortably on one v5e chip; a multi-chip mesh
     would use the per-device 128 of the config instead.
@@ -855,10 +1019,15 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
                 duration_s=serve_duration_s, buckets=tuple(serve_buckets),
                 max_wait_ms=serve_max_wait_ms,
             )
+        elif metrics == "paired":
+            result = measure_metrics_overhead(
+                batch_size=min(batch_size, 64), epochs=step_path_epochs,
+                steps_cap=step_path_steps,
+            )
         elif step_path:
             result = measure_step_path(
                 batch_size, step_path_epochs, tuple(prefetch_depths),
-                step_path_steps,
+                step_path_steps, metrics=metrics,
             )
         else:
             result = measure(batch_size, iters, compute_dtype, fused_n,
@@ -869,11 +1038,13 @@ def main(batch_size: int = 512, iters: int = 50, compute_dtype: str = "float32",
         result = {
             "metric": ("serve_overload" if serve and serve_pattern
                        else "serve_throughput" if serve
+                       else "metrics_overhead" if metrics == "paired"
                        else "step_path_prefetch" if step_path
                        else "train_step_throughput"),
             "value": 0.0,
             "unit": ("ms" if serve and serve_pattern
-                     else "req/s" if serve else "img/s"),
+                     else "req/s" if serve
+                     else "frac" if metrics == "paired" else "img/s"),
             "vs_baseline": 0.0,
             "backend": backend,
             "error": f"{type(e).__name__}: {e}",
@@ -927,6 +1098,12 @@ if __name__ == "__main__":
                    help="fraction of requests sent high-priority")
     p.add_argument("--serve_capacity", type=int, default=24,
                    help="front-end in-flight admission capacity")
+    p.add_argument("--metrics", choices=["on", "off", "paired"],
+                   default="on",
+                   help="metrics-registry toggle for the step-path modes: "
+                   "'off' swaps in the no-op NullRegistry, 'paired' runs "
+                   "the on-vs-off overhead measurement the CI metrics "
+                   "overhead gate consumes")
     a = p.parse_args()
     main(a.batch_size, a.iters, a.compute_dtype, a.fused_n, not a.no_bf16,
          a.cpu_full, a.step_path,
@@ -935,4 +1112,5 @@ if __name__ == "__main__":
          a.serve, a.serve_duration_s,
          tuple(int(b) for b in a.serve_buckets.split(",")),
          a.serve_max_wait_ms, a.serve_pattern, a.serve_rps,
-         a.serve_replicas, a.serve_high_frac, a.serve_capacity)
+         a.serve_replicas, a.serve_high_frac, a.serve_capacity,
+         a.metrics)

@@ -187,7 +187,36 @@ SCHEMA = {
             "last_step_ms": NUM,
             "age_s": NUM,
             "fresh": bool,
+            # Registry progress digest (telemetry/metrics.py MetricsPump):
+            # absolute counters + derived rates, so the supervisor's stall
+            # probe can tell "alive but stalled" (fresh beat, frozen
+            # counters) from "making progress" without scraping anything.
+            "steps_total": NUM,
+            "step_rate": NUM,
+            "serve_requests_total": NUM,
+            "serve_qps": NUM,
         },
+        None,
+    ),
+    # Metrics-plane snapshot (telemetry/metrics.py MetricsPump): one atomic
+    # registry copy per cadence.  counters/gauges map Prometheus-style
+    # series names to values; histograms map them to exponential-bucket
+    # payloads ({count, sum, lowest, growth, buckets}); rates carries the
+    # per-second counter deltas vs the previous flush.
+    "metrics_snapshot": (
+        {"source": str, "counters": dict, "gauges": dict,
+         "histograms": dict},
+        {"seq": NUM, "interval_s": NUM, "rates": dict, "replica": NUM,
+         "up": dict},
+        None,
+    ),
+    # SLO burn-rate alert (scripts/metrics_agent.py): multi-window burn-rate
+    # evaluation tripped — the error budget is burning `burn_rate` times
+    # faster than the objective allows over both the long and short window.
+    "slo_burn": (
+        {"slo": str, "burn_rate": NUM, "threshold": NUM, "window_s": NUM},
+        {"severity": str, "short_window_s": NUM, "short_burn_rate": NUM,
+         "objective": NUM, "bad": NUM, "total": NUM},
         None,
     ),
     # Flight recorder (telemetry/flight.py): the ring-buffer tail dumped on

@@ -1,0 +1,437 @@
+#!/usr/bin/env python
+"""Fleet metrics scraper + SLO burn-rate alerter (stdlib-only).
+
+The fleet's time-series plane has two producers: serving processes expose a
+Prometheus-text ``/metrics`` endpoint (``serving/replica.py`` and
+``serving/frontend.py``), and train processes pump ``metrics_snapshot``
+records into their JSONL streams (``telemetry/metrics.py``).  This agent is
+the consumer that makes them ONE fleet: every poll it
+
+1. scrapes each ``--replica host:port`` endpoint (a failed or stale scrape
+   marks that replica ``up=0`` and its series simply go stale — they stop
+   contributing, they are never zeroed, so a SIGKILL'd replica cannot drag
+   the aggregate down with phantom zeros),
+2. tails the newest ``metrics_snapshot`` out of each ``--train-log`` JSONL,
+3. merges everything — counters sum, gauges last-wins, histograms fold
+   element-wise over identical bucket ladders (associative, so order never
+   matters), and
+4. appends one fleet-aggregate ``metrics_snapshot`` record (source
+   ``"fleet"``, plus the per-replica ``up`` map) to ``--out``.
+
+On top sit SLO objects (``--slo`` JSON, repeatable) with multi-window
+burn-rate alerting in the Google-SRE style: the burn rate is the error
+ratio over a window divided by the SLO's error budget ``1 - objective``;
+an alert fires only when BOTH the long and the short window exceed the
+threshold (the long window gives significance, the short one proves the
+burn is still happening), emitting an edge-triggered ``slo_burn`` record —
+one per activation, not one per poll.
+
+Stdlib-only on purpose, like ``scripts/supervise.py``: the scraper must
+keep observing a fleet whose accelerator runtime is wedged, so it imports
+neither jax nor the repo packages.  It carries its own small exposition
+parser; the merge semantics mirror ``telemetry/metrics.py``.
+
+Usage:
+  python scripts/metrics_agent.py --replica 127.0.0.1:9101 \\
+      --replica 127.0.0.1:9102 --train-log exp/run.jsonl \\
+      --out exp/fleet_metrics.jsonl --interval_s 2 \\
+      --slo '{"name":"availability","bad":"fe_failed_total",
+              "total":"fe_requests_total","objective":0.999,
+              "window_s":30,"short_window_s":5,"threshold":2.0}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$'
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+# --------------------------------------------------------------------------- #
+# Exposition parsing (Prometheus text format v0.0.4)
+# --------------------------------------------------------------------------- #
+
+
+def _series_key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse exposition text into ``{"counters", "gauges", "histograms"}``.
+
+    Histograms come back in *ladder* form — ``{"le": [bounds...],
+    "cum": [cumulative counts...], "sum": s, "count": n}`` with the final
+    ``+Inf`` bound as ``math.inf`` — the canonical fleet-merge shape (two
+    cumulative ladders over identical bounds merge by element-wise
+    addition, which is associative and commutative).
+    """
+    types: dict = {}
+    counters: dict = {}
+    gauges: dict = {}
+    hist: dict = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        name = m.group("name")
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if types.get(base) == "histogram" and name != base:
+            le = labels.pop("le", None)
+            key = _series_key(base, labels)
+            h = hist.setdefault(
+                key, {"le": [], "cum": [], "sum": 0.0, "count": 0})
+            if name.endswith("_bucket") and le is not None:
+                bound = math.inf if le in ("+Inf", "inf") else float(le)
+                h["le"].append(bound)
+                h["cum"].append(value)
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = int(value)
+        elif types.get(name) == "counter":
+            counters[_series_key(name, labels)] = value
+        else:
+            gauges[_series_key(name, labels)] = value
+    for h in hist.values():
+        order = sorted(range(len(h["le"])), key=lambda i: h["le"][i])
+        h["le"] = [h["le"][i] for i in order]
+        h["cum"] = [h["cum"][i] for i in order]
+    return {"counters": counters, "gauges": gauges, "histograms": hist}
+
+
+def snapshot_to_ladder(snap: dict) -> dict:
+    """Convert a ``metrics_snapshot`` record's histogram form (``lowest`` /
+    ``growth`` / per-bucket counts) into the same ladder form the exposition
+    parser produces, so train and serve histograms merge identically."""
+    out = {"counters": dict(snap.get("counters", {})),
+           "gauges": dict(snap.get("gauges", {})),
+           "histograms": {}}
+    for key, h in snap.get("histograms", {}).items():
+        n = len(h["buckets"]) - 1
+        le = [h["lowest"] * h["growth"] ** i for i in range(n)] + [math.inf]
+        cum, running = [], 0.0
+        for c in h["buckets"]:
+            running += c
+            cum.append(running)
+        out["histograms"][key] = {
+            "le": le, "cum": cum, "sum": h["sum"], "count": h["count"]}
+    return out
+
+
+def merge_ladders(a: dict, b: dict) -> dict:
+    """Element-wise merge of two ladder histograms over identical bounds."""
+    if a["le"] != b["le"]:
+        raise ValueError("cannot merge histograms with different le ladders")
+    return {
+        "le": list(a["le"]),
+        "cum": [x + y for x, y in zip(a["cum"], b["cum"])],
+        "sum": round(a["sum"] + b["sum"], 6),
+        "count": a["count"] + b["count"],
+    }
+
+
+def merge_parsed(parts: list) -> dict:
+    """Fold N parsed/converted metric sets into one fleet aggregate."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for part in parts:
+        for k, v in part.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0.0) + v
+        for k, v in part.get("gauges", {}).items():
+            out["gauges"][k] = v
+        for k, h in part.get("histograms", {}).items():
+            prev = out["histograms"].get(k)
+            out["histograms"][k] = (
+                dict(h, le=list(h["le"]), cum=list(h["cum"]))
+                if prev is None else merge_ladders(prev, h))
+    return out
+
+
+def ladder_quantile(h: dict, q: float) -> float:
+    """Quantile from a cumulative ladder, saturating at the largest finite
+    bound (the +Inf bucket must not invent an unbounded estimate)."""
+    total = h["count"]
+    if total <= 0:
+        return 0.0
+    finite = [b for b in h["le"] if b != math.inf]
+    if not finite:
+        return 0.0
+    target = q * total
+    for bound, cum in zip(h["le"], h["cum"]):
+        if cum >= target:
+            return bound if bound != math.inf else finite[-1]
+    return finite[-1]
+
+
+def sum_counters(counters: dict, name: str) -> float:
+    """Sum every series of a base name across its label sets."""
+    total = 0.0
+    for key, v in counters.items():
+        base = key.split("{", 1)[0]
+        if base == name:
+            total += v
+    return total
+
+
+# --------------------------------------------------------------------------- #
+# SLO burn-rate evaluation (multi-window, edge-triggered)
+# --------------------------------------------------------------------------- #
+
+
+class SloMonitor:
+    """One SLO object over fleet counter series.
+
+    ``spec`` fields: ``name``, ``bad`` (counter series of SLO-violating
+    events), ``total`` (counter series of all events), ``objective``
+    (e.g. 0.999), ``window_s`` (long window), ``short_window_s``,
+    ``threshold`` (burn-rate multiple that pages).  Burn rate over a
+    window = (Δbad / Δtotal) / (1 - objective); 1.0 means the error
+    budget is being spent exactly at the sustainable rate.
+    """
+
+    def __init__(self, spec: dict):
+        self.name = str(spec["name"])
+        self.bad = str(spec["bad"])
+        self.total = str(spec["total"])
+        self.objective = float(spec.get("objective", 0.999))
+        self.window_s = float(spec.get("window_s", 60.0))
+        self.short_window_s = float(
+            spec.get("short_window_s", max(self.window_s / 12.0, 1.0)))
+        self.threshold = float(spec.get("threshold", 2.0))
+        self.severity = str(spec.get("severity", "page"))
+        self._history: list = []  # (mono, bad_total, total_total)
+        self._active = False
+
+    def _burn(self, now: float, window_s: float) -> float:
+        cutoff = now - window_s
+        base = None
+        for sample in self._history:
+            if sample[0] <= cutoff:
+                base = sample
+            else:
+                break
+        if base is None:
+            base = self._history[0]
+        head = self._history[-1]
+        d_bad = head[1] - base[1]
+        d_total = head[2] - base[2]
+        if d_total <= 0:
+            return 0.0
+        ratio = d_bad / d_total
+        budget = max(1.0 - self.objective, 1e-9)
+        return ratio / budget
+
+    def observe(self, now: float, counters: dict) -> dict:
+        """Feed one poll's fleet counters; returns the evaluation, with
+        ``fire=True`` exactly once per threshold crossing (edge trigger —
+        the alert de-activates only when the LONG window recovers)."""
+        bad = sum_counters(counters, self.bad)
+        total = sum_counters(counters, self.total)
+        self._history.append((now, bad, total))
+        cutoff = now - 2 * self.window_s
+        while len(self._history) > 2 and self._history[1][0] <= cutoff:
+            self._history.pop(0)
+        long_burn = self._burn(now, self.window_s)
+        short_burn = self._burn(now, self.short_window_s)
+        over = long_burn > self.threshold and short_burn > self.threshold
+        fire = over and not self._active
+        if over:
+            self._active = True
+        elif long_burn <= self.threshold:
+            self._active = False
+        return {
+            "slo": self.name,
+            "burn_rate": round(long_burn, 4),
+            "short_burn_rate": round(short_burn, 4),
+            "threshold": self.threshold,
+            "window_s": self.window_s,
+            "short_window_s": self.short_window_s,
+            "objective": self.objective,
+            "bad": bad,
+            "total": total,
+            "severity": self.severity,
+            "fire": fire,
+        }
+
+
+# --------------------------------------------------------------------------- #
+# Scraping
+# --------------------------------------------------------------------------- #
+
+
+def scrape_replica(endpoint: str, timeout_s: float = 2.0) -> dict:
+    """GET ``http://<endpoint>/metrics`` and parse; raises OSError-family
+    on any transport failure (the caller turns that into ``up=0``)."""
+    url = f"http://{endpoint}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return parse_exposition(resp.read().decode())
+
+
+def tail_snapshot(path: str, stale_s: float) -> dict:
+    """Newest fresh ``metrics_snapshot`` record in a JSONL stream, in
+    ladder form; ``{}`` when the file is missing, torn, has no snapshot,
+    or the newest one is older than ``stale_s``."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return {}
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn trailing line mid-write: legal, skip
+        if rec.get("type") != "metrics_snapshot":
+            continue
+        if stale_s > 0 and time.time() - float(rec.get("ts", 0)) > stale_s:
+            return {}
+        return snapshot_to_ladder(rec)
+    return {}
+
+
+def poll_once(replicas: list, train_logs: list, stale_s: float,
+              timeout_s: float = 2.0) -> dict:
+    """One fleet poll: scrape + tail + merge.  Returns the aggregate plus
+    the per-source ``up`` map (replica index / train log path -> 0 or 1)."""
+    parts = []
+    up: dict = {}
+    for i, endpoint in enumerate(replicas):
+        try:
+            parts.append(scrape_replica(endpoint, timeout_s))
+            up[f"replica_{i}"] = 1
+        except (OSError, urllib.error.URLError, ValueError):
+            up[f"replica_{i}"] = 0
+    for path in train_logs:
+        snap = tail_snapshot(path, stale_s)
+        key = f"train_{os.path.basename(path)}"
+        if snap:
+            parts.append(snap)
+            up[key] = 1
+        else:
+            up[key] = 0
+    agg = merge_parsed(parts)
+    for key, alive in up.items():
+        agg["gauges"][f'up{{source="{key}"}}'] = float(alive)
+    return {"aggregate": agg, "up": up}
+
+
+def _emit(out_path: str, record: dict) -> None:
+    """Append one JSONL record (same append-mode discipline as
+    ``utils.logging.JsonlLogger`` — no tmp file needed for appends)."""
+    with open(out_path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def _json_histograms(hist: dict) -> dict:
+    """Ladder histograms with JSON-safe bounds (inf -> null)."""
+    out = {}
+    for key, h in hist.items():
+        out[key] = {
+            "le": [None if b == math.inf else b for b in h["le"]],
+            "cum": h["cum"],
+            "sum": h["sum"],
+            "count": h["count"],
+        }
+    return out
+
+
+def run_agent(args) -> int:
+    slos = [SloMonitor(json.loads(s)) for s in args.slo]
+    deadline = (time.monotonic() + args.duration_s
+                if args.duration_s > 0 else None)
+    seq = 0
+    fired = 0
+    while True:
+        t_poll = time.monotonic()
+        polled = poll_once(args.replica, args.train_log, args.stale_s,
+                           timeout_s=args.scrape_timeout_s)
+        agg = polled["aggregate"]
+        seq += 1
+        _emit(args.out, {
+            "type": "metrics_snapshot",
+            "ts": time.time(),
+            "source": "fleet",
+            "seq": seq,
+            "interval_s": args.interval_s,
+            "counters": agg["counters"],
+            "gauges": agg["gauges"],
+            "histograms": _json_histograms(agg["histograms"]),
+            "up": polled["up"],
+        })
+        for slo in slos:
+            verdict = slo.observe(t_poll, agg["counters"])
+            if verdict.pop("fire"):
+                fired += 1
+                verdict["type"] = "slo_burn"
+                verdict["ts"] = time.time()
+                _emit(args.out, verdict)
+                print(f"| metrics_agent: SLO burn: {verdict['slo']} "
+                      f"burn_rate={verdict['burn_rate']} "
+                      f"(threshold {verdict['threshold']})", flush=True)
+        if args.once:
+            break
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        time.sleep(max(args.interval_s - (time.monotonic() - t_poll), 0.05))
+    print(f"| metrics_agent: {seq} poll(s), {fired} slo_burn record(s) "
+          f"-> {args.out}", flush=True)
+    return 0
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("cil-tpu fleet metrics agent")
+    p.add_argument("--replica", action="append", default=[],
+                   help="replica or front-end /metrics endpoint host:port "
+                   "(repeatable)")
+    p.add_argument("--train-log", action="append", default=[],
+                   help="train-process JSONL stream to tail for "
+                   "metrics_snapshot records (repeatable)")
+    p.add_argument("--out", required=True,
+                   help="fleet-aggregate JSONL output (appended)")
+    p.add_argument("--interval_s", type=float, default=2.0)
+    p.add_argument("--duration_s", type=float, default=0.0,
+                   help="stop after this long (0 = run until killed)")
+    p.add_argument("--once", action="store_true",
+                   help="one poll, one record, exit (tests)")
+    p.add_argument("--stale_s", type=float, default=30.0,
+                   help="a train snapshot older than this is stale (up=0)")
+    p.add_argument("--scrape_timeout_s", type=float, default=2.0)
+    p.add_argument("--slo", action="append", default=[],
+                   help="SLO spec JSON: {name, bad, total, objective, "
+                   "window_s, short_window_s, threshold} (repeatable)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    return run_agent(_parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

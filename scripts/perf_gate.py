@@ -25,11 +25,22 @@ line, and compares against the ``bench_gate`` entry in ``BASELINE.json``:
 
 ``--serve`` gates the serving path instead: ``bench.py --serve`` (the
 micro-batching inference server over an exported artifact) against the
-``serve_gate`` baseline entry.  The hard gate is closed-loop ``p99_ms`` —
+``serve_gate`` baseline entry.  The hard gate is the closed-loop p99 —
 tail latency is the serving SLO, and a batcher bug (lost wakeup, lock held
-across dispatch) shows up there long before mean throughput moves.  A
-baseline from a different backend, bucket set, or max-wait is incomparable
-and SKIPs, same rule as the train gate.
+across dispatch) shows up there long before mean throughput moves.  When
+both the baseline and the run carry ``hist_p99_ms`` (the p99 scraped from
+the server's own ``serve_batch_latency_ms`` registry histograms — every
+request the server observed, not one run's sample list), the gate compares
+those, rung-based: the ladder quantizes values to powers of ``growth``, so
+the limit is one rung of slack rather than a percentage.  A baseline from a
+different backend, bucket set, or max-wait is incomparable and SKIPs, same
+rule as the train gate.
+
+``--metrics-overhead`` gates the metrics plane itself: ``bench.py
+--metrics paired`` runs the identical compiled step with the live registry
+and with the no-op ``NullRegistry`` and the gate fails if the instrumented
+step is more than 3% slower.  Self-relative, so no baseline entry exists
+for it.
 
 ``--serve-overload`` gates the fleet under overload: ``bench.py --serve
 --serve_pattern bursty`` drives a replicated front end (admission control +
@@ -59,6 +70,11 @@ DEFAULT_TOLERANCE = 0.15
 # The serve gate's p99 tolerance rides the same 15% headroom; run-to-run p99
 # noise beyond it means the batcher, not the scheduler, changed behaviour.
 SERVE_TOLERANCE = 0.15
+# Registry-on vs registry-off step cost: observability must stay effectively
+# free.  3% is far above the real per-step instrument cost (two lock-guarded
+# float adds, ~us against a ms-scale step) but below any change that put the
+# registry on the wrong side of a dispatch or took its lock inside another.
+METRICS_OVERHEAD_MAX = 0.03
 FETCH_FACTOR = 3.0   # loose multiplicative gate for fetch_overhead_ms
 FETCH_SLACK_MS = 5.0  # absolute slack on top of the factor
 FETCH_ARM_MS = 1.0   # the fetch gate arms only at a meaningful baseline
@@ -131,8 +147,76 @@ def gate(result: dict, baseline: dict) -> dict:
     return {"status": "fail" if reasons else "pass", "reasons": reasons}
 
 
+def _pick_p99(result: dict, baseline: dict, exact_key: str, hist_key: str):
+    """Choose the p99 pair a serve gate compares.
+
+    Prefers the registry-scraped histogram p99 when BOTH sides recorded one:
+    the scraped series aggregates every request the server itself observed
+    (the same ``/metrics`` ladder the fleet scraper reads), where a single
+    bench run's exact percentile is one noisy sample.  Histogram values are
+    quantized to the exponential ladder, so the caller gates them rung-based
+    (one ``growth`` factor of slack) instead of the percentage tolerance —
+    and a mixed exact-vs-hist comparison is never made, because the ladder's
+    upper-bound bias would read as a fake regression.
+
+    Returns ``(measured, base, key, growth)``; ``growth`` is None in exact
+    mode.
+    """
+    if (result.get(hist_key) is not None
+            and baseline.get(hist_key) is not None):
+        growth = (baseline.get("hist_growth")
+                  or result.get("hist_growth") or 2.0)
+        return result[hist_key], baseline[hist_key], hist_key, growth
+    return result.get(exact_key), baseline.get(exact_key), exact_key, None
+
+
+def _p99_verdict(p99, base_p99, key: str, growth, tol: float, what: str):
+    """Shared limit logic for both serve gates: rung-based when scraped,
+    percentage-based when exact.  Returns (reasons, improved)."""
+    reasons = []
+    if growth is not None:
+        limit = base_p99 * growth * 1.01  # one ladder rung of slack
+        slack = f"one {growth:g}x rung above baseline {base_p99:.1f}"
+        improved = p99 < base_p99 / growth * 0.99
+    else:
+        limit = base_p99 * (1.0 + tol)
+        slack = f"baseline {base_p99:.1f} + {tol:.0%}"
+        improved = p99 < base_p99 * (1.0 - tol)
+    if p99 > limit:
+        reasons.append(f"{what} {key} regressed: {p99:.1f} > {limit:.1f} "
+                       f"({slack})")
+    return reasons, improved
+
+
+def gate_metrics_overhead(result: dict) -> dict:
+    """Metrics-plane overhead gate: registry-on step cost vs registry-off.
+
+    Self-relative (the paired bench measures both modes over the identical
+    compiled step in one process), so there is no baseline entry to drift —
+    the gate is the constant ``METRICS_OVERHEAD_MAX``.
+    """
+    if result.get("error"):
+        return {"status": "fail",
+                "reasons": [f"metrics-overhead bench did not produce a "
+                            f"valid measurement: {result['error']}"]}
+    overhead = result.get("overhead_frac")
+    if overhead is None:
+        return {"status": "fail",
+                "reasons": ["no overhead_frac in the bench result"]}
+    if overhead > METRICS_OVERHEAD_MAX:
+        return {"status": "fail",
+                "reasons": [
+                    f"metrics registry overhead {overhead:.1%} exceeds "
+                    f"{METRICS_OVERHEAD_MAX:.0%} (step_ms on/off: "
+                    f"{result.get('step_ms_on')}/"
+                    f"{result.get('step_ms_off')})"]}
+    return {"status": "pass", "reasons": []}
+
+
 def gate_serve(result: dict, baseline: dict) -> dict:
-    """Serving gate: closed-loop p99_ms vs the ``serve_gate`` entry."""
+    """Serving gate: closed-loop p99 vs the ``serve_gate`` entry — the
+    scraped ``hist_p99_ms`` when both sides have it, exact ``p99_ms``
+    otherwise (see ``_pick_p99``)."""
     if result.get("error") or not result.get("value"):
         return {"status": "fail",
                 "reasons": [f"serve bench did not produce a valid "
@@ -149,22 +233,17 @@ def gate_serve(result: dict, baseline: dict) -> dict:
                                 f"{baseline[key]!r} vs measured "
                                 f"{result.get(key)!r} — refresh the baseline "
                                 "on this machine (--serve --update-baseline)"]}
-    tol = baseline.get("tolerance", DEFAULT_TOLERANCE)
-    base_p99 = baseline.get("p99_ms")
-    p99 = result.get("p99_ms")
+    tol = baseline.get("tolerance", SERVE_TOLERANCE)
+    p99, base_p99, key, growth = _pick_p99(
+        result, baseline, "p99_ms", "hist_p99_ms")
     if base_p99 is None or p99 is None:
         return {"status": "skip",
                 "reasons": ["no p99_ms to compare (baseline entry missing — "
                             "record one with --serve --update-baseline)"]}
-    reasons = []
-    limit = base_p99 * (1.0 + tol)
-    if p99 > limit:
+    reasons, improved = _p99_verdict(p99, base_p99, key, growth, tol, "serve")
+    if not reasons and improved:
         reasons.append(
-            f"serve p99_ms regressed: {p99:.1f} > {limit:.1f} "
-            f"(baseline {base_p99:.1f} + {tol:.0%})")
-    if not reasons and p99 < base_p99 * (1.0 - tol):
-        reasons.append(
-            f"note: serve p99_ms improved {base_p99:.1f} -> {p99:.1f}; "
+            f"note: serve {key} improved {base_p99:.1f} -> {p99:.1f}; "
             "refresh the baseline to tighten the gate")
         return {"status": "pass", "reasons": reasons}
     return {"status": "fail" if reasons else "pass", "reasons": reasons}
@@ -191,22 +270,18 @@ def gate_serve_overload(result: dict, baseline: dict) -> dict:
                                 "on this machine (--serve-overload "
                                 "--update-baseline)"]}
     tol = baseline.get("tolerance", SERVE_TOLERANCE)
-    base_p99 = baseline.get("p99_high_ms")
-    p99 = result.get("p99_high_ms")
+    p99, base_p99, key, growth = _pick_p99(
+        result, baseline, "p99_high_ms", "hist_p99_high_ms")
     if base_p99 is None or p99 is None:
         return {"status": "skip",
                 "reasons": ["no p99_high_ms to compare (baseline entry "
                             "missing — record one with --serve-overload "
                             "--update-baseline)"]}
-    reasons = []
-    limit = base_p99 * (1.0 + tol)
-    if p99 > limit:
+    reasons, improved = _p99_verdict(
+        p99, base_p99, key, growth, tol, "overload")
+    if not reasons and improved:
         reasons.append(
-            f"overload p99_high_ms regressed: {p99:.1f} > {limit:.1f} "
-            f"(baseline {base_p99:.1f} + {tol:.0%})")
-    if not reasons and p99 < base_p99 * (1.0 - tol):
-        reasons.append(
-            f"note: overload p99_high_ms improved {base_p99:.1f} -> "
+            f"note: overload {key} improved {base_p99:.1f} -> "
             f"{p99:.1f}; refresh the baseline to tighten the gate")
         return {"status": "pass", "reasons": reasons}
     return {"status": "fail" if reasons else "pass", "reasons": reasons}
@@ -226,6 +301,8 @@ def update_baseline(result: dict, path: str = _BASELINE,
     if overload:
         entry = {
             "p99_high_ms": result.get("p99_high_ms"),
+            "hist_p99_high_ms": result.get("hist_p99_high_ms"),
+            "hist_growth": result.get("hist_growth"),
             "backend": result.get("backend"),
             "replicas": result.get("replicas"),
             "pattern": result.get("pattern"),
@@ -238,6 +315,8 @@ def update_baseline(result: dict, path: str = _BASELINE,
     elif serve:
         entry = {
             "p99_ms": result.get("p99_ms"),
+            "hist_p99_ms": result.get("hist_p99_ms"),
+            "hist_growth": result.get("hist_growth"),
             "p50_ms": result.get("p50_ms"),
             "req_s": result.get("value"),
             "backend": result.get("backend"),
@@ -275,6 +354,10 @@ def main(argv=None) -> int:
     p.add_argument("--serve-overload", action="store_true",
                    help="gate the fleet overload bench (bench.py --serve "
                    "--serve_pattern bursty) against serve_overload_gate")
+    p.add_argument("--metrics-overhead", action="store_true",
+                   help="gate the metrics-plane cost (bench.py --metrics "
+                   "paired) against the fixed 3%% registry-on vs "
+                   "registry-off budget — no baseline entry involved")
     p.add_argument("--result", default=None,
                    help="gate this JSON result instead of running bench.py "
                    "(tests / canned measurements)")
@@ -282,7 +365,11 @@ def main(argv=None) -> int:
                    help="path to BASELINE.json")
     args = p.parse_args(argv)
 
-    if args.serve_overload:
+    if args.metrics_overhead:
+        extra = ("--metrics", "paired",
+                 "--step_path_epochs", "1", "--step_path_steps", "4")
+        entry_key = "metrics_overhead_gate"
+    elif args.serve_overload:
         # Fixed args so the recorded baseline stays comparable run to run.
         extra = ("--serve", "--serve_pattern", "bursty", "--serve_rps", "40",
                  "--serve_duration_s", "3", "--serve_buckets", "1,8")
@@ -295,6 +382,20 @@ def main(argv=None) -> int:
         entry_key = "bench_gate"
     result = (json.loads(args.result) if args.result
               else run_bench(extra_args=extra))
+    if args.metrics_overhead:
+        # Self-relative gate: no baseline entry, no --update-baseline.
+        verdict = gate_metrics_overhead(result)
+        print(json.dumps({
+            "metric": "perf_gate",
+            "gate": entry_key,
+            "status": verdict["status"],
+            "reasons": verdict["reasons"],
+            "measured": {k: result.get(k) for k in
+                         ("overhead_frac", "step_ms_on", "step_ms_off",
+                          "passes", "backend")},
+            "budget": METRICS_OVERHEAD_MAX,
+        }))
+        return 1 if verdict["status"] == "fail" else 0
     if args.update_baseline:
         entry = update_baseline(result, args.baseline, serve=args.serve,
                                 overload=args.serve_overload)
@@ -304,12 +405,13 @@ def main(argv=None) -> int:
     baseline = load_baseline(args.baseline).get(entry_key, {})
     if args.serve_overload:
         verdict = gate_serve_overload(result, baseline)
-        measured_keys = ("p99_high_ms", "value", "errors", "backend",
-                         "replicas", "pattern", "rps", "capacity")
+        measured_keys = ("p99_high_ms", "hist_p99_high_ms", "value",
+                         "errors", "backend", "replicas", "pattern", "rps",
+                         "capacity")
     elif args.serve:
         verdict = gate_serve(result, baseline)
-        measured_keys = ("p99_ms", "p50_ms", "value", "failed", "backend",
-                         "buckets", "max_wait_ms")
+        measured_keys = ("p99_ms", "hist_p99_ms", "p50_ms", "value",
+                         "failed", "backend", "buckets", "max_wait_ms")
     else:
         verdict = gate(result, baseline)
         measured_keys = ("step_ms", "fetch_overhead_ms", "value", "backend",

@@ -266,6 +266,82 @@ def render_serve(by_type):
         print()
 
 
+def render_metrics(by_type):
+    """Metrics-plane panel: the registry time series (``metrics_snapshot``
+    records that the in-process pump and the fleet scraper flush) and any
+    ``slo_burn`` burn-rate alerts, per source."""
+    snaps = by_type["metrics_snapshot"]
+    burns = by_type["slo_burn"]
+    if not (snaps or burns):
+        return
+    print("## metrics plane\n")
+    if snaps:
+        by_source = {}
+        for s in snaps:
+            by_source.setdefault(s.get("source", "?"), []).append(s)
+        print("| source | snapshots | span s | series | key totals |")
+        print("|---|---|---|---|---|")
+        for source, rows in sorted(by_source.items()):
+            last = rows[-1]
+            span = last.get("ts", 0) - rows[0].get("ts", 0)
+            counters = last.get("counters", {})
+            nseries = (len(counters) + len(last.get("gauges", {}))
+                       + len(last.get("histograms", {})))
+            totals = {}
+            for k, v in counters.items():
+                base = k.split("{", 1)[0]
+                totals[base] = totals.get(base, 0.0) + v
+            key_cell = " ".join(
+                f"{n}={totals[n]:g}"
+                for n in ("steps_total", "serve_requests_total",
+                          "fe_requests_total", "fe_shed_total")
+                if n in totals) or "—"
+            print(f"| {source} | {len(rows)} | {span:.0f} | {nseries} | "
+                  f"{key_cell} |")
+        print()
+        for source, rows in sorted(by_source.items()):
+            # The throughput story over time: per-snapshot summed rate of
+            # the progress series, most recent last.
+            history = []
+            for s in rows:
+                rates = s.get("rates") or {}
+                for name in ("steps_total", "serve_requests_total",
+                             "fe_requests_total"):
+                    total = sum(v for k, v in rates.items()
+                                if k.split("{", 1)[0] == name)
+                    if total or any(k.split("{", 1)[0] == name
+                                    for k in rates):
+                        history.append((name, total))
+                        break
+            if history:
+                name = history[0][0]
+                tail = ", ".join(f"{r:.1f}" for _, r in history[-10:])
+                print(f"{source} {name}/s (last {min(len(history), 10)} "
+                      f"snapshots): {tail}\n")
+        fleet_rows = by_source.get("fleet")
+        if fleet_rows:
+            up = fleet_rows[-1].get("up") or {}
+            if up:
+                alive = sum(1 for v in up.values() if v)
+                down = ", ".join(
+                    k for k, v in sorted(up.items()) if not v)
+                print(f"fleet scrape targets up: {alive}/{len(up)} "
+                      f"({('down: ' + down) if down else 'all healthy'})\n")
+    if burns:
+        print("SLO burn-rate alerts:\n")
+        print("| ts | slo | severity | burn long/short | threshold "
+              "| window s |")
+        print("|---|---|---|---|---|---|")
+        for b in sorted(burns, key=lambda r: r.get("ts", 0)):
+            short = b.get("short_burn_rate")
+            burn_cell = (f"{b.get('burn_rate', 0):.2f}/"
+                         + (f"{short:.2f}" if short is not None else "—"))
+            print(f"| {b.get('ts', '?')} | **{b.get('slo', '?')}** | "
+                  f"{b.get('severity', '—')} | {burn_cell} | "
+                  f"{b.get('threshold', '—')} | {b.get('window_s', '—')} |")
+        print()
+
+
 def render_hbm(hbm):
     if not hbm:
         return
@@ -706,6 +782,7 @@ def main(run_path: str, second_path: str | None = None,
     render_recompiles(by_type["recompile"], by_type["recompile_warning"])
     render_lockstep(by_type)
     render_serve(by_type)
+    render_metrics(by_type)
     render_hbm(by_type["hbm"])
     render_fleet(run_path)
     if jaxlint_path:

@@ -341,6 +341,10 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
         create_model,
         grow,
     )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (  # noqa: E501
+        MetricsPump,
+        MetricsRegistry,
+    )
     from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (  # noqa: E501
         JsonlLogger,
     )
@@ -436,6 +440,13 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
             fe_log = os.path.join(tmp, "frontend.jsonl")
             sink = JsonlLogger(fe_log)
             check.bind_sink(sink)
+            # The front end's registry pumps metrics_snapshot records into
+            # fe_log — the snapshot-file path of the fleet scraper, merged
+            # with the replicas' live /metrics expositions below.
+            fe_metrics = MetricsRegistry()
+            fe_pump = MetricsPump(fe_metrics, sink, interval_s=1.0,
+                                  source="frontend")
+            fe_pump.start()
             fe = Frontend(
                 [("127.0.0.1", p) for p in ports],
                 capacity=6, low_watermark=2,       # tight: bursts must shed
@@ -450,7 +461,33 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                 probe_s=0.5,
                 export_dir=serve_dir, rollout_poll_s=1.0,
                 sink=sink,
+                metrics=fe_metrics,
             ).start()
+
+            # Fleet scraper sidecar: polls every replica's /metrics plus the
+            # front end's snapshot stream, merges them, and evaluates one
+            # shed-rate SLO.  Overload shedding is continuous in this smoke
+            # (capacity 6 against 10 hammering clients), so the edge-
+            # triggered monitor must fire exactly once and stay active.
+            agent_out = os.path.join(tmp, "fleet_metrics.jsonl")
+            shed_slo = {
+                "name": "fleet-shed", "bad": "fe_shed_total",
+                "total": "fe_requests_total", "objective": 0.999,
+                "window_s": 30.0, "short_window_s": 5.0,
+                "threshold": 0.05, "severity": "ticket",
+            }
+            agent_cmd = [
+                sys.executable,
+                os.path.join(_REPO, "scripts", "metrics_agent.py"),
+                "--out", agent_out, "--interval_s", "1.0",
+                "--train-log", fe_log, "--slo", json.dumps(shed_slo),
+            ]
+            for port in ports:
+                agent_cmd += ["--replica", f"127.0.0.1:{port}"]
+            agent_console = open(os.path.join(tmp, "agent_console.log"), "wb")
+            agent_proc = subprocess.Popen(
+                agent_cmd, cwd=_REPO, stdout=agent_console,
+                stderr=subprocess.STDOUT)
 
             results = {"high": [], "low": []}
             sheds = {"high": 0, "low": 0}
@@ -548,7 +585,15 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                 for t in clients:
                     t.join()
                 fe_stats = fe.stats()
+                fe_pump.stop()
                 fe.stop()
+                agent_proc.terminate()
+                try:
+                    agent_proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    agent_proc.kill()
+                    agent_proc.wait()
+                agent_console.close()
             threadcheck.uninstall()
 
             # ---------------- assertions ---------------- #
@@ -601,6 +646,71 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                 failures.append("SIGKILL under traffic produced no "
                                 "frontend_retry record")
 
+            # ---- metrics plane: the scraped fleet aggregate must survive
+            # the SIGKILL chaos — the dead replica's series goes stale
+            # (up=0) and comes back, the aggregate never loses the serve
+            # counters the survivors keep feeding, and the edge-triggered
+            # shed SLO fires exactly once for the whole overloaded run.
+            def _series_sum(counters, name):
+                return sum(v for k, v in counters.items()
+                           if k.split("{", 1)[0] == name)
+
+            agent_recs = _records(agent_out)
+            fleet_snaps = [r for r in agent_recs
+                           if r.get("type") == "metrics_snapshot"]
+            burns = [r for r in agent_recs if r.get("type") == "slo_burn"]
+            if len(fleet_snaps) < 5:
+                failures.append(
+                    f"fleet scraper produced only {len(fleet_snaps)} "
+                    "snapshot(s)")
+            else:
+                ups = [s.get("up", {}).get(f"replica_{KILL_REPLICA}")
+                       for s in fleet_snaps]
+                if 0 not in ups:
+                    failures.append(
+                        "killed replica's scrape never went stale (up=0)")
+                elif 1 not in ups[ups.index(0):]:
+                    failures.append(
+                        "killed replica's scrape never recovered after "
+                        "relaunch")
+                ts_seq = [s.get("ts", 0) for s in fleet_snaps]
+                max_gap = max(b - a for a, b in zip(ts_seq, ts_seq[1:]))
+                if max_gap > 15.0:
+                    failures.append(
+                        f"fleet scrape cadence broke: {max_gap:.1f}s gap "
+                        "between snapshots")
+                served_polls = [
+                    i for i, s in enumerate(fleet_snaps)
+                    if _series_sum(s.get("counters", {}),
+                                   "serve_requests_total") > 0]
+                if not served_polls:
+                    failures.append(
+                        "fleet aggregate never saw serve_requests_total")
+                else:
+                    dropped = [
+                        fleet_snaps[i].get("seq")
+                        for i in range(served_polls[0], len(fleet_snaps))
+                        if i not in served_polls]
+                    if dropped:
+                        failures.append(
+                            "fleet aggregate qps went dark during the kill "
+                            f"window (polls {dropped[:5]})")
+                last_snap = fleet_snaps[-1]
+                if not any(k.split("{", 1)[0] == "serve_batch_latency_ms"
+                           for k in last_snap.get("histograms", {})):
+                    failures.append(
+                        "no serve_batch_latency_ms histograms in the "
+                        "merged fleet aggregate")
+                if _series_sum(last_snap.get("counters", {}),
+                               "fe_requests_total") <= 0:
+                    failures.append(
+                        "front-end snapshot stream never merged into the "
+                        "fleet aggregate")
+            if (len(burns) != 1 or burns[0].get("slo") != "fleet-shed"):
+                failures.append(
+                    "expected exactly one fleet-shed slo_burn, got "
+                    f"{[(b.get('slo'), b.get('ts')) for b in burns]}")
+
             # Lock discipline: zero violations in this process AND in every
             # replica subprocess (they all ran --check_threads).
             replica_logs = [
@@ -621,7 +731,7 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
             lint = subprocess.run(
                 [sys.executable,
                  os.path.join(_REPO, "scripts", "check_telemetry_schema.py"),
-                 fe_log, *replica_logs],
+                 fe_log, agent_out, *replica_logs],
                 cwd=_REPO, timeout=120, capture_output=True, text=True)
             if lint.returncode != 0:
                 failures.append(
@@ -640,6 +750,8 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                 "rollout_swaps": fe_stats["rollout_swaps"],
                 "rollout_rollbacks": fe_stats["rollout_rollbacks"],
                 "converged_tasks": converged_tasks,
+                "fleet_snapshots": len(fleet_snaps),
+                "slo_burns": len(burns),
             }))
             return 0 if not failures else 1
         finally:

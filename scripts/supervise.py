@@ -114,6 +114,19 @@ def _parse_args(argv: List[str]):
                    help="the child's fault fire-ledger "
                    "(<ckpt_dir>/fault_ledger.jsonl); included in "
                    "crash_report.json when given")
+    p.add_argument("--stall_age", type=float, default=0.0,
+                   help="seconds without progress (the heartbeat's "
+                   "metrics digest fields frozen while the beat stays "
+                   "fresh) that counts as a stall and triggers a kill + "
+                   "relaunch (0 = progress watching off)")
+    p.add_argument("--stall_fields", default="steps_total,serve_requests_total",
+                   help="comma-separated heartbeat digest fields watched "
+                   "by --stall_age; a process whose beat carries none of "
+                   "them is never stall-killed")
+    p.add_argument("--metrics_agent", default=None,
+                   help="argument string for scripts/metrics_agent.py, run "
+                   "as a sidecar for the supervised run's lifetime "
+                   "(e.g. '--replica 127.0.0.1:9101 --out fleet.jsonl')")
     p.add_argument("--log", default=None,
                    help="also append the JSON event lines here")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -136,6 +149,14 @@ class Supervisor:
                 else os.getpid() ^ int(time.time() * 1000))
         self._rng = random.Random(seed)
         self._prev_delay = 0.0  # decorrelated-jitter state
+        self._stall_fields = [
+            f for f in (s.strip() for s in args.stall_fields.split(","))
+            if f
+        ]
+        # Per-heartbeat progress memory: path -> (digest tuple, last time
+        # the tuple changed).  Reset at every (re)launch — a fresh child
+        # starts its counters over.
+        self._progress: dict = {}
 
     # ------------------------------------------------------------------ #
 
@@ -173,6 +194,38 @@ class Supervisor:
                 worst = age
         return worst
 
+    def _progress_stalled(self) -> Optional[dict]:
+        """'Alive but stalled' probe: the heartbeat file keeps getting
+        rewritten (fresh mtime — the liveness probe stays quiet) while the
+        metrics digest fields the pump embeds (``steps_total`` /
+        ``serve_requests_total``) have not moved for ``--stall_age``.  A
+        beat carrying none of the watched fields is never stall-killed —
+        absence of the digest means the metrics plane is off, not that the
+        process stopped progressing."""
+        stall_age = self.args.stall_age
+        if stall_age <= 0:
+            return None
+        now = time.monotonic()
+        worst: Optional[tuple] = None
+        for path in self._heartbeat_paths():
+            beat = self._read_json(path)
+            if beat is None:
+                continue
+            vals = tuple(beat.get(f) for f in self._stall_fields)
+            if all(v is None for v in vals):
+                continue
+            prev = self._progress.get(path)
+            if prev is None or prev[0] != vals:
+                self._progress[path] = (vals, now)
+                continue
+            age = now - prev[1]
+            if age > stall_age and (worst is None or age > worst[1]):
+                worst = (path, age)
+        if worst is None:
+            return None
+        return {"heartbeat": worst[0], "stalled_s": round(worst[1], 1),
+                "fields": list(self._stall_fields)}
+
     def _kill_group(self, proc: subprocess.Popen) -> None:
         """SIGTERM then SIGKILL the child's whole process group (the trainer
         may have its own children: compile workers, profilers)."""
@@ -191,6 +244,7 @@ class Supervisor:
         """Launch and babysit one child; returns (returncode, uptime_s,
         hung)."""
         start = time.monotonic()
+        self._progress.clear()  # a fresh child restarts its counters
         proc = subprocess.Popen(cmd, start_new_session=True)
         self._event("launch", pid=proc.pid, cmd=cmd)
         hung = False
@@ -206,6 +260,13 @@ class Supervisor:
             if age is not None:
                 self._event("hang", pid=proc.pid,
                             heartbeat_age_s=round(age, 1))
+                self._kill_group(proc)
+                hung = True
+                rc = proc.returncode if proc.returncode is not None else -9
+                break
+            stall = self._progress_stalled()
+            if stall is not None:
+                self._event("stall", pid=proc.pid, **stall)
                 self._kill_group(proc)
                 hung = True
                 rc = proc.returncode if proc.returncode is not None else -9
@@ -299,6 +360,37 @@ class Supervisor:
     # ------------------------------------------------------------------ #
 
     def run(self) -> int:
+        sidecar = self._start_metrics_agent()
+        try:
+            return self._run_loop()
+        finally:
+            self._stop_metrics_agent(sidecar)
+
+    def _start_metrics_agent(self) -> Optional[subprocess.Popen]:
+        """Optional scraper sidecar: one metrics_agent.py lives for the
+        whole supervised run (it spans relaunches — the fleet aggregate
+        must not restart when a child does)."""
+        if not self.args.metrics_agent:
+            return None
+        import shlex
+
+        agent = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "metrics_agent.py")
+        cmd = [sys.executable, agent] + shlex.split(self.args.metrics_agent)
+        proc = subprocess.Popen(cmd, start_new_session=True)
+        self._event("metrics_agent", pid=proc.pid, cmd=cmd)
+        return proc
+
+    def _stop_metrics_agent(self, proc: Optional[subprocess.Popen]) -> None:
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def _run_loop(self) -> int:
         args = self.args
         cmd = list(args.command)
         attempt = 0

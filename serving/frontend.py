@@ -100,7 +100,18 @@ class Frontend:
         rollout_poll_s: Optional[float] = None,
         sink=None,
         faults=None,
+        metrics=None,
     ):
+        # Lazy import: telemetry/metrics.py is itself stdlib-only, but its
+        # package __init__ pulls numpy — resolving it here keeps this
+        # *module* importable with nothing but the stdlib.
+        if metrics is None:
+            from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (  # noqa: E501
+                MetricsRegistry,
+            )
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
         self.replicas = [(h, int(p)) for h, p in replicas]
         self.capacity = int(capacity)
         self.low_watermark = (int(low_watermark) if low_watermark is not None
@@ -126,16 +137,38 @@ class Frontend:
         self._lock = threading.Lock()
         self._inflight = {"high": 0, "low": 0}
         self._rr = 0  # round-robin cursor
-        self._served: Dict[str, int] = {p: 0 for p in PRIORITIES}
-        self._failed: Dict[str, int] = {p: 0 for p in PRIORITIES}
-        self._shed: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._last_shed_emit: Dict[str, float] = {p: 0.0 for p in PRIORITIES}
-        self._retries = 0
-        self._hedges = 0
-        self._hedge_wins = 0
-        self._rollout_swaps = 0
-        self._rollout_rollbacks = 0
         self._latencies: Dict[str, List[float]] = {p: [] for p in PRIORITIES}
+        # Fleet counters live in the registry (the /metrics exposition the
+        # scraper polls; /stats reads the same instruments).  Registry
+        # updates always run OUTSIDE self._lock: the registry has its own
+        # lock and the two must never nest (threadlint JL303).
+        reg = self.metrics
+        self._m_served = {
+            p: reg.counter("fe_requests_total", priority=p)
+            for p in PRIORITIES
+        }
+        self._m_failed = {
+            p: reg.counter("fe_failed_total", priority=p) for p in PRIORITIES
+        }
+        self._m_shed = {
+            p: reg.counter("fe_shed_total", priority=p) for p in PRIORITIES
+        }
+        self._m_latency = {
+            p: reg.histogram("fe_latency_ms", lowest=0.5, growth=2.0,
+                             buckets=18, priority=p)
+            for p in PRIORITIES
+        }
+        self._m_inflight = {
+            p: reg.gauge("fe_inflight", priority=p) for p in PRIORITIES
+        }
+        self._m_retries = reg.counter("fe_retries_total")
+        self._m_hedges = reg.counter("fe_hedges_total")
+        self._m_hedge_wins = reg.counter("fe_hedge_wins_total")
+        self._m_rollout_swaps = reg.counter("fe_rollout_swaps_total")
+        self._m_rollout_rollbacks = reg.counter("fe_rollout_rollbacks_total")
+        self._m_ejected = reg.gauge("fe_ejected_replicas")
+        self._m_ejections = reg.counter("fe_ejections_total")
 
         self._stop = threading.Event()
         # Hedged attempts need a second thread per request; cap the pool so
@@ -169,6 +202,9 @@ class Frontend:
                 elif self.path == "/healthz":
                     self._reply(200, json.dumps(
                         {"replicas": frontend.health.stats()}).encode())
+                elif self.path == "/metrics":
+                    self._reply(200, frontend.metrics.to_prometheus().encode(),
+                                ctype="text/plain; version=0.0.4")
                 else:
                     self._reply(404, b'{"error": "no route"}')
 
@@ -253,23 +289,26 @@ class Frontend:
         deadline = t0 + max(deadline_ms, 1.0) / 1000.0
         try:
             payload, hdrs = self._dispatch_hedged(body, deadline)
+            lat_ms = (time.perf_counter() - t0) * 1000.0
             with self._lock:
-                self._served[priority] += 1
                 lat = self._latencies[priority]
-                lat.append((time.perf_counter() - t0) * 1000.0)
+                lat.append(lat_ms)
                 if len(lat) > 16384:
                     del lat[:-8192]
+            self._m_served[priority].inc()
+            self._m_latency[priority].observe(lat_ms)
             hdrs["X-Priority"] = priority
             return payload, hdrs
         except _Shed:
             raise
         except Exception:
-            with self._lock:
-                self._failed[priority] += 1
+            self._m_failed[priority].inc()
             raise
         finally:
             with self._lock:
                 self._inflight[priority] -= 1
+                left = self._inflight[priority]
+            self._m_inflight[priority].set(left)
 
     def _admit(self, priority: str) -> None:
         now = time.monotonic()
@@ -277,21 +316,25 @@ class Frontend:
             total = self._inflight["high"] + self._inflight["low"]
             limit = (self.capacity if priority == "high"
                      else self.low_watermark)
-            if total >= limit:
-                self._shed[priority] += 1
-                shed_total = self._shed[priority]
+            if total < limit:
+                self._inflight[priority] += 1
+                now_inflight = self._inflight[priority]
+            else:
+                now_inflight = None
                 emit = now - self._last_shed_emit[priority] > 0.5
                 if emit:
                     self._last_shed_emit[priority] = now
-            else:
-                self._inflight[priority] += 1
-                return
+        if now_inflight is not None:
+            self._m_inflight[priority].set(now_inflight)
+            return
+        shed = self._m_shed[priority]
+        shed.inc()
         # Sheds are per-request events at overload rates — emit at most ~2/s
         # per class, carrying the cumulative count, so the telemetry stream
         # does not amplify the very overload it reports.
         if emit and self._sink is not None:
             self._sink.log("serve_shed", priority=priority, queued=total,
-                           capacity=limit, shed_total=shed_total)
+                           capacity=limit, shed_total=int(shed.value))
         raise _Shed(f"over {priority} admission limit ({total}/{limit})")
 
     def _pick(self, exclude: frozenset) -> Optional[int]:
@@ -358,8 +401,7 @@ class Frontend:
             except Exception as e:  # noqa: BLE001 — every flavor fails over
                 last = e
                 self.health.note_error(replica)
-                with self._lock:
-                    self._retries += 1
+                self._m_retries.inc()
                 if self._sink is not None:
                     self._sink.log("frontend_retry", replica=replica,
                                    attempt=attempt, error=repr(e))
@@ -384,8 +426,7 @@ class Frontend:
         # Primary still pending at the hedge point: race a second attempt
         # on a different replica; first success wins, the loser's result
         # is discarded (replicas are stateless per-request).
-        with self._lock:
-            self._hedges += 1
+        self._m_hedges.inc()
         hedge = self._hedge_pool.submit(
             self._dispatch_chain, body, deadline,
             frozenset(chosen[:1]), [])
@@ -404,8 +445,7 @@ class Frontend:
                     last = e
                     continue
                 if fut is hedge:
-                    with self._lock:
-                        self._hedge_wins += 1
+                    self._m_hedge_wins.inc()
                 return payload, hdrs
         raise last if last is not None else OSError("request deadline hit")
 
@@ -414,11 +454,21 @@ class Frontend:
     # ------------------------------------------------------------------ #
 
     def _monitor_loop(self) -> None:
+        known_ejected: set = set()
         while not self._stop.wait(self.probe_s):
             self.health.check_heartbeats()
-            for replica in self.health.ejected():
+            ejected = set(self.health.ejected())
+            # Transition counting stays local to this (single) thread; the
+            # registry carries the level and the cumulative eject count.
+            fresh = ejected - known_ejected
+            if fresh:
+                self._m_ejections.inc(len(fresh))
+            self._m_ejected.set(len(ejected))
+            known_ejected = ejected
+            for replica in sorted(ejected):
                 if self._probe_ready(replica):
                     self.health.note_ready(replica)
+                    known_ejected.discard(replica)
 
     def _probe_ready(self, replica: int) -> bool:
         """Out-of-band ``/healthz`` probe: the replica must answer AND be
@@ -485,8 +535,7 @@ class Frontend:
             ok, detail = self._swap_replica(replica, latest)
             if not ok:
                 behind.append(replica)
-                with self._lock:
-                    self._rollout_rollbacks += 1
+                self._m_rollout_rollbacks.inc()
                 if self._sink is not None:
                     self._sink.log(
                         "serve_rollback", task_id=latest,
@@ -500,8 +549,7 @@ class Frontend:
                 # fleet must not march into it.
                 break
             moved.append(replica)
-            with self._lock:
-                self._rollout_swaps += 1
+            self._m_rollout_swaps.inc()
         return {"converged": not behind and not moved, "latest": latest,
                 "moved": moved, "behind": behind}
 
@@ -533,26 +581,32 @@ class Frontend:
     # ------------------------------------------------------------------ #
 
     def stats(self) -> dict:
+        """Same dict shape as ever; the counts are now *read back* from the
+        registry (the single source of truth /metrics also exposes), while
+        the percentiles keep coming from the exact latency samples."""
         with self._lock:
-            out = {
-                "served": dict(self._served),
-                "failed": dict(self._failed),
-                "shed": dict(self._shed),
-                "retries": self._retries,
-                "hedges": self._hedges,
-                "hedge_wins": self._hedge_wins,
-                "rollout_swaps": self._rollout_swaps,
-                "rollout_rollbacks": self._rollout_rollbacks,
-                "inflight": dict(self._inflight),
-                "latency_ms": {},
+            inflight = dict(self._inflight)
+            sorted_lat = {p: sorted(self._latencies[p]) for p in PRIORITIES}
+        # Registry reads happen after self._lock is released (never nested).
+        out = {
+            "served": {p: int(self._m_served[p].value) for p in PRIORITIES},
+            "failed": {p: int(self._m_failed[p].value) for p in PRIORITIES},
+            "shed": {p: int(self._m_shed[p].value) for p in PRIORITIES},
+            "retries": int(self._m_retries.value),
+            "hedges": int(self._m_hedges.value),
+            "hedge_wins": int(self._m_hedge_wins.value),
+            "rollout_swaps": int(self._m_rollout_swaps.value),
+            "rollout_rollbacks": int(self._m_rollout_rollbacks.value),
+            "inflight": inflight,
+            "latency_ms": {},
+        }
+        for p in PRIORITIES:
+            vals = sorted_lat[p]
+            out["latency_ms"][p] = {
+                "count": len(vals),
+                "p50": round(_percentile(vals, 50), 3),
+                "p95": round(_percentile(vals, 95), 3),
+                "p99": round(_percentile(vals, 99), 3),
             }
-            for p in PRIORITIES:
-                vals = sorted(self._latencies[p])
-                out["latency_ms"][p] = {
-                    "count": len(vals),
-                    "p50": round(_percentile(vals, 50), 3),
-                    "p95": round(_percentile(vals, 95), 3),
-                    "p99": round(_percentile(vals, 99), 3),
-                }
         out["health"] = self.health.stats()
         return out

@@ -70,7 +70,12 @@ class ReplicaServer:
         sink=None,
         faults=None,
         request_timeout_s: float = 30.0,
+        metrics=None,
     ):
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (  # noqa: E501
+            MetricsRegistry,
+        )
+
         from .server import InferenceServer
 
         self.replica_id = int(replica_id)
@@ -78,6 +83,12 @@ class ReplicaServer:
         self._faults = faults
         self._telemetry = telemetry
         self._warm = threading.Event()
+        # A replica always carries a live registry (the /metrics exposition
+        # the fleet scraper polls) unless the telemetry facade was built
+        # with --no_metrics, in which case its NullRegistry wins.
+        if metrics is None and telemetry is not None:
+            metrics = getattr(telemetry, "metrics", None)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.server = InferenceServer(
             export_dir,
             max_wait_ms=max_wait_ms,
@@ -86,6 +97,7 @@ class ReplicaServer:
             faults=faults,
             auto_swap=False,
             replica_id=self.replica_id,
+            metrics=self.metrics,
         )
         replica = self
 
@@ -121,6 +133,12 @@ class ReplicaServer:
                     stats["replica"] = replica.replica_id
                     stats["trace_count"] = replica.server.trace_count()
                     self._reply_json(200, stats)
+                elif self.path == "/metrics":
+                    self._reply(
+                        200,
+                        replica.metrics.to_prometheus().encode(),
+                        ctype="text/plain; version=0.0.4",
+                    )
                 else:
                     self._reply_json(404, {"error": f"no route {self.path}"})
 
@@ -294,6 +312,9 @@ def main(argv=None) -> int:
     p.add_argument("--fault_ledger", default=None)
     p.add_argument("--check_threads", action="store_true")
     p.add_argument("--heartbeat_s", type=float, default=2.0)
+    p.add_argument("--metrics_interval_s", type=float, default=2.0,
+                   help="MetricsPump flush cadence for metrics_snapshot "
+                   "records + the heartbeat's serve-qps digest")
     args = p.parse_args(argv)
 
     check = None
@@ -317,6 +338,8 @@ def main(argv=None) -> int:
         telemetry = Telemetry(
             telemetry_dir=args.telemetry_dir, sink=sink,
             heartbeat_interval_s=args.heartbeat_s,
+            metrics_interval_s=args.metrics_interval_s,
+            metrics_source="replica",
         )
         if check is not None:
             check.bind_sink(telemetry.sink)

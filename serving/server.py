@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+    NullRegistry,
     RecompileMonitor,
 )
 from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.logging import (
@@ -74,6 +75,7 @@ class InferenceServer:
         latency_log_every: int = 256,
         auto_swap: bool = True,
         replica_id: Optional[int] = None,
+        metrics=None,
     ):
         self.export_dir = export_dir
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1000.0
@@ -89,6 +91,18 @@ class InferenceServer:
         self._faults = faults
         self.monitor = monitor if monitor is not None else RecompileMonitor(self._sink)
         self.latency_log_every = int(latency_log_every)
+        # Time-series registry (telemetry/metrics.py): explicit > the
+        # telemetry facade's > no-op.  Instrument updates always run OUTSIDE
+        # self._lock — the registry has its own lock and the two must never
+        # nest (lock-order discipline, threadlint JL303).
+        if metrics is None and telemetry is not None:
+            metrics = getattr(telemetry, "metrics", None)
+        self.metrics = metrics if metrics is not None else NullRegistry()
+        self._m_requests = self.metrics.counter("serve_requests_total")
+        self._m_failed = self.metrics.counter("serve_failed_total")
+        self._m_batches = self.metrics.counter("serve_batches_total")
+        self._m_queue_depth = self.metrics.gauge("serve_queue_depth")
+        self._m_bucket_occ = self.metrics.gauge("serve_bucket_occupancy")
 
         self._lock = threading.Lock()
         self._queue: "queue.Queue" = queue.Queue()
@@ -250,6 +264,7 @@ class InferenceServer:
                 _item[1].set_exception(e)
             with self._lock:
                 self._failed += n
+            self._m_failed.inc(n)
             print(f"| serve: batch of {n} failed: {e!r}")
             return
         done = time.perf_counter()
@@ -273,6 +288,18 @@ class InferenceServer:
             self._slots += bucket
             self._bucket_counts[bucket] = self._bucket_counts.get(bucket, 0) + 1
             flush = self._window_served >= self.latency_log_every
+            occupancy = self._served / self._slots if self._slots else 0.0
+        # Registry updates after self._lock is released (never nested).
+        self._m_requests.inc(n)
+        self._m_batches.inc()
+        self._m_queue_depth.set(self._queue.qsize())
+        self._m_bucket_occ.set(occupancy)
+        hist = self.metrics.histogram(
+            "serve_batch_latency_ms", lowest=0.5, growth=2.0, buckets=18,
+            bucket=str(bucket),
+        )
+        for item in batch:
+            hist.observe((done - item[2]) * 1000.0)
         if flush:
             self._flush_latency()
 

@@ -314,3 +314,149 @@ def test_fleet_health_heartbeat_staleness(tmp_path):
     # A missing file is NOT stale: a replica may simply not have telemetry.
     os.unlink(paths[0])
     assert fh.check_heartbeats() == []
+
+
+# --------------------------------------------------------------------------- #
+# Metrics plane: /metrics exposition + fleet histogram merge
+# --------------------------------------------------------------------------- #
+
+
+def _get(port, path, timeout=10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _metrics_agent():
+    """Load scripts/metrics_agent.py (stdlib-only, not a package module) —
+    its exposition parser is the reference consumer of /metrics."""
+    import importlib.util
+    import pathlib
+
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "scripts" / "metrics_agent.py")
+    spec = importlib.util.spec_from_file_location("metrics_agent_fe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_exposition_matches_observed_traffic(fleet2):
+    """The front end's /metrics must agree, counter for counter, with what
+    live two-priority traffic actually experienced: served 200s, shed 503s,
+    latency histogram counts — and after a replica death, the retry counter
+    must match the frontend_retry sink records one to one."""
+    agent = _metrics_agent()
+    for s in fleet2:
+        s.latency_s = 0.15
+    sink = ListSink()
+    fe = Frontend([("127.0.0.1", s.port) for s in fleet2],
+                  capacity=2, low_watermark=1, error_threshold=3,
+                  sink=sink).start()
+    try:
+        outcomes = {"high": [], "low": []}
+        lock = threading.Lock()
+
+        def lo():
+            st, _ = _post(fe.port, headers={"X-Priority": "low"})
+            with lock:
+                outcomes["low"].append(st)
+
+        def hi():
+            for _ in range(4):
+                st, _ = _post(fe.port, headers={"X-Priority": "high"})
+                with lock:
+                    outcomes["high"].append(st)
+
+        threads = [threading.Thread(target=lo) for _ in range(12)]
+        threads.append(threading.Thread(target=hi))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        st, body = _get(fe.port, "/metrics")
+        assert st == 200
+        parsed = agent.parse_exposition(body.decode())
+        c = parsed["counters"]
+        served = {p: outcomes[p].count(200) for p in ("high", "low")}
+        shed = {p: outcomes[p].count(503) for p in ("high", "low")}
+        assert served["high"] == 4 and shed["low"] >= 1
+        for p in ("high", "low"):
+            assert c.get(f'fe_requests_total{{priority="{p}"}}', 0) == served[p]
+            assert c.get(f'fe_shed_total{{priority="{p}"}}', 0) == shed[p]
+        # /stats reads the SAME instruments — the two surfaces cannot skew.
+        stats = fe.stats()
+        assert stats["served"] == served and stats["shed"] == shed
+        # Latency histograms: one ladder per priority, counts == serves.
+        h = parsed["histograms"]
+        for p in ("high", "low"):
+            if served[p]:
+                lad = h[f'fe_latency_ms{{priority="{p}"}}']
+                assert lad["count"] == served[p]
+                assert lad["cum"][-1] == served[p]
+                assert lad["sum"] > 0
+                # ~150ms stub latency: nothing lands at or below 0.5ms.
+                assert lad["cum"][0] == 0
+
+        # Replica death -> failover: fe_retries_total and the sink's
+        # frontend_retry records are incremented side by side (1:1).
+        fleet2[0].stop()
+        for _ in range(6):
+            assert _post(fe.port)[0] == 200
+        st, body = _get(fe.port, "/metrics")
+        assert st == 200
+        c2 = agent.parse_exposition(body.decode())["counters"]
+        retries = len(sink.of("frontend_retry"))
+        assert retries >= 1
+        assert c2.get("fe_retries_total", 0) == retries
+        assert fe.stats()["retries"] == retries
+    finally:
+        fe.stop()
+
+
+def test_histogram_merge_across_replicas_is_associative():
+    """Three replicas' expositions fold into one fleet distribution the
+    same way regardless of merge order (the property the scraper leans on),
+    and the merged quantile reads from the combined ladder."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (  # noqa: E501
+        MetricsRegistry,
+    )
+
+    agent = _metrics_agent()
+    samples = {0: [1.0, 2.0], 1: [4.0, 4.0, 4.0], 2: [64.0]}
+    parts = []
+    for rid, values in samples.items():
+        reg = MetricsRegistry()
+        hist = reg.histogram("serve_batch_latency_ms", lowest=1.0,
+                             growth=2.0, buckets=8)
+        for v in values:
+            hist.observe(v)
+        reg.counter("serve_requests_total").inc(len(values))
+        parts.append(agent.parse_exposition(reg.to_prometheus()))
+
+    key = "serve_batch_latency_ms"
+    a, b, c = (p["histograms"][key] for p in parts)
+    left = agent.merge_ladders(agent.merge_ladders(a, b), c)
+    right = agent.merge_ladders(a, agent.merge_ladders(b, c))
+    assert left == right
+    assert left["count"] == 6
+    assert left["sum"] == pytest.approx(79.0)
+    # merge_parsed (the scraper's fold) agrees with the pairwise merges.
+    agg = agent.merge_parsed(parts)
+    assert agg["histograms"][key] == left
+    assert agg["counters"]["serve_requests_total"] == 6
+    # Quantiles on the merged ladder: the p50 of {1,2,4,4,4,64} sits in the
+    # 4ms bucket; p99 reaches the 64ms observation's bucket upper bound.
+    assert agent.ladder_quantile(left, 0.5) == 4.0
+    assert agent.ladder_quantile(left, 0.99) == 64.0
+    # Mismatched ladders must refuse to merge, never silently mangle.
+    other = MetricsRegistry()
+    other.histogram(key, lowest=1.0, growth=2.0, buckets=4).observe(1.0)
+    odd = agent.parse_exposition(other.to_prometheus())["histograms"][key]
+    with pytest.raises(ValueError):
+        agent.merge_ladders(left, odd)

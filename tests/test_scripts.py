@@ -821,3 +821,243 @@ def test_report_run_renders_lockstep_panel(tmp_path):
     assert "1 violation(s)" in text
     assert "fingerprint_mismatch" in text and "step 4" in text
     assert "digest" in text
+
+
+# --------------------------------------------------------------------------- #
+# metrics plane: schema, overhead gate, rung-based serve gates, fleet agent,
+# and the supervisor's stalled-vs-progressing probe
+# --------------------------------------------------------------------------- #
+
+
+def _snapshot_record(**over):
+    rec = {"type": "metrics_snapshot", "ts": 1.0, "source": "train",
+           "seq": 3, "interval_s": 10.0,
+           "counters": {"steps_total": 42.0},
+           "gauges": {"prefetch_ring_occupancy": 2.0},
+           "histograms": {"step_latency_ms": {
+               "count": 2, "sum": 3.5, "lowest": 0.5, "growth": 2.0,
+               "buckets": [1, 1, 0]}},
+           "rates": {"steps_total": 4.2}}
+    rec.update(over)
+    return rec
+
+
+def test_schema_accepts_metrics_plane_records():
+    m = _load_script("check_telemetry_schema")
+    assert m.check_record(_snapshot_record(), "x") == []
+    # The fleet aggregate adds the per-source up map; rates/seq optional.
+    fleet = _snapshot_record(source="fleet", up={"replica_0": 1,
+                                                "train_run.jsonl": 0})
+    del fleet["rates"]
+    assert m.check_record(fleet, "x") == []
+    burn = {"type": "slo_burn", "ts": 2.0, "slo": "availability",
+            "burn_rate": 14.4, "short_burn_rate": 20.0, "threshold": 2.0,
+            "window_s": 30.0, "short_window_s": 5.0, "objective": 0.999,
+            "bad": 12.0, "total": 400.0, "severity": "page"}
+    assert m.check_record(burn, "x") == []
+    lean_burn = {"type": "slo_burn", "ts": 2.0, "slo": "a",
+                 "burn_rate": 3.0, "threshold": 2.0, "window_s": 30.0}
+    assert m.check_record(lean_burn, "x") == []
+
+
+def test_schema_rejects_malformed_metrics_plane_records():
+    m = _load_script("check_telemetry_schema")
+    no_source = _snapshot_record()
+    del no_source["source"]
+    assert any("source" in e for e in m.check_record(no_source, "x"))
+    assert any("counters" in e for e in m.check_record(
+        _snapshot_record(counters="nope"), "x"))
+    assert any("burn_rate" in e for e in m.check_record(
+        {"type": "slo_burn", "ts": 1.0, "slo": "a", "threshold": 2.0,
+         "window_s": 30.0}, "x"))
+    assert any("window_s" in e for e in m.check_record(
+        {"type": "slo_burn", "ts": 1.0, "slo": "a", "burn_rate": 3.0,
+         "threshold": 2.0, "window_s": "30"}, "x"))
+
+
+def _overhead_result(**over):
+    out = {"metric": "metrics_overhead", "value": 0.012,
+           "overhead_frac": 0.012, "step_ms_on": 101.2, "step_ms_off": 100.0,
+           "passes": 3, "backend": "cpu"}
+    out.update(over)
+    return out
+
+
+def test_metrics_overhead_gate_thresholds():
+    m = _load_script("perf_gate")
+    assert m.gate_metrics_overhead(_overhead_result())["status"] == "pass"
+    # Metrics measurably cheaper than no metrics = noise; still a pass.
+    fast = _overhead_result(overhead_frac=-0.01)
+    assert m.gate_metrics_overhead(fast)["status"] == "pass"
+    v = m.gate_metrics_overhead(_overhead_result(overhead_frac=0.08))
+    assert v["status"] == "fail"
+    assert any("overhead" in r for r in v["reasons"])
+    assert m.gate_metrics_overhead({"error": "boom"})["status"] == "fail"
+    assert m.gate_metrics_overhead({"metric": "x"})["status"] == "fail"
+
+
+def test_metrics_overhead_gate_cli(tmp_path):
+    m = _load_script("perf_gate")
+    base = str(tmp_path / "BASELINE.json")
+    ok = json.dumps(_overhead_result())
+    assert m.main(["--metrics-overhead", "--result", ok,
+                   "--baseline", base]) == 0
+    hot = json.dumps(_overhead_result(overhead_frac=0.05))
+    assert m.main(["--metrics-overhead", "--result", hot,
+                   "--baseline", base]) == 1
+    # Self-relative gate: no baseline entry is ever written or required.
+    assert not os.path.exists(base)
+
+
+def test_serve_gates_prefer_hist_p99_rung_based():
+    m = _load_script("perf_gate")
+    base = dict(_OVERLOAD_BASE, hist_p99_high_ms=64.0, hist_growth=2.0)
+    # Histogram p99s are quantized to the bucket ladder, so the gate allows
+    # one growth-factor rung of slack — and in hist mode the (noisy) exact
+    # percentile is not what gets compared.
+    same_rung = _overload_result(p99_high_ms=500.0, hist_p99_high_ms=64.0)
+    assert m.gate_serve_overload(same_rung, base)["status"] == "pass"
+    one_up = _overload_result(hist_p99_high_ms=128.0)
+    assert m.gate_serve_overload(one_up, base)["status"] == "pass"
+    two_up = _overload_result(hist_p99_high_ms=256.0)
+    v = m.gate_serve_overload(two_up, base)
+    assert v["status"] == "fail"
+    assert any("hist_p99_high_ms regressed" in r for r in v["reasons"])
+    # Baseline without a scraped p99: exact fallback, percentage tolerance
+    # (mixed exact-vs-hist comparisons are never made).
+    mixed = _overload_result(p99_high_ms=130.0, hist_p99_high_ms=128.0)
+    v = m.gate_serve_overload(mixed, _OVERLOAD_BASE)
+    assert v["status"] == "fail"
+    assert any("p99_high_ms regressed" in r for r in v["reasons"])
+
+
+def test_pick_p99_contract():
+    m = _load_script("perf_gate")
+    result = {"p99_ms": 31.0, "hist_p99_ms": 32.0, "hist_growth": 4.0}
+    base = {"p99_ms": 30.0, "hist_p99_ms": 64.0}
+    measured, b, key, growth = m._pick_p99(result, base, "p99_ms",
+                                           "hist_p99_ms")
+    assert (measured, b, key, growth) == (32.0, 64.0, "hist_p99_ms", 4.0)
+    measured, b, key, growth = m._pick_p99(result, {"p99_ms": 30.0},
+                                           "p99_ms", "hist_p99_ms")
+    assert (measured, b, key, growth) == (31.0, 30.0, "p99_ms", None)
+
+
+def test_slo_monitor_multiwindow_edge_trigger():
+    agent = _load_script("metrics_agent")
+    slo = agent.SloMonitor({"name": "avail", "bad": "bad_total",
+                            "total": "req_total", "objective": 0.99,
+                            "window_s": 30.0, "short_window_s": 5.0,
+                            "threshold": 2.0})
+    # First poll establishes the base; no delta can ever fire it.
+    assert not slo.observe(0.0, {"bad_total": 0.0, "req_total": 0.0})["fire"]
+    v = slo.observe(5.0, {"bad_total": 0.0, "req_total": 100.0})
+    assert v["burn_rate"] == 0.0 and not v["fire"]
+    # 10% errors against a 1% budget: burn 10x in BOTH windows -> fires.
+    v = slo.observe(10.0, {"bad_total": 10.0, "req_total": 200.0})
+    assert v["fire"] and v["burn_rate"] > 2.0 and v["short_burn_rate"] > 2.0
+    # Still burning: edge-triggered, no second record.
+    assert not slo.observe(15.0, {"bad_total": 20.0,
+                                  "req_total": 300.0})["fire"]
+    # Short window goes clean but the long window is still hot: the alert
+    # must stay active (deactivating here would re-fire on the next blip).
+    v = slo.observe(20.0, {"bad_total": 20.0, "req_total": 400.0})
+    assert not v["fire"]
+    assert v["short_burn_rate"] == 0.0 and v["burn_rate"] > 2.0
+    # Long window recovers -> deactivates; a NEW burn then fires again.
+    v = slo.observe(45.0, {"bad_total": 20.0, "req_total": 500.0})
+    assert v["burn_rate"] == 0.0 and not v["fire"]
+    v = slo.observe(50.0, {"bad_total": 40.0, "req_total": 600.0})
+    assert v["fire"]
+
+
+def test_metrics_agent_tail_snapshot(tmp_path):
+    import math
+    import time as _time
+
+    agent = _load_script("metrics_agent")
+    path = str(tmp_path / "run.jsonl")
+    now = _time.time()
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "epoch", "ts": now}) + "\n")
+        f.write(json.dumps(_snapshot_record(ts=now)) + "\n")
+        f.write('{"type": "metrics_snapshot", "ts"')  # torn mid-append
+    lad = agent.tail_snapshot(path, stale_s=60.0)
+    assert lad["counters"]["steps_total"] == 42.0
+    h = lad["histograms"]["step_latency_ms"]
+    # Ladder form: +Inf final bound, cumulative counts ending at count.
+    assert h["le"] == [0.5, 1.0, math.inf]
+    assert h["cum"] == [1, 2, 2] and h["count"] == 2
+    # A stale snapshot contributes nothing (never phantom zeros).
+    _write_jsonl(path, [_snapshot_record(ts=now - 600)])
+    assert agent.tail_snapshot(path, stale_s=60.0) == {}
+    assert agent.tail_snapshot(str(tmp_path / "missing.jsonl"), 60.0) == {}
+
+
+def test_metrics_agent_poll_marks_dead_sources_down(tmp_path):
+    import time as _time
+
+    agent = _load_script("metrics_agent")
+    log = str(tmp_path / "run.jsonl")
+    _write_jsonl(log, [_snapshot_record(ts=_time.time())])
+    # Port 1 on localhost refuses instantly: the replica scrape fails but
+    # the poll still merges the healthy train source.
+    polled = agent.poll_once(["127.0.0.1:1"], [log], stale_s=60.0,
+                             timeout_s=0.5)
+    assert polled["up"] == {"replica_0": 0, "train_run.jsonl": 1}
+    agg = polled["aggregate"]
+    assert agg["counters"]["steps_total"] == 42.0
+    assert agg["gauges"]['up{source="replica_0"}'] == 0.0
+    assert agg["gauges"]['up{source="train_run.jsonl"}'] == 1.0
+
+
+def test_supervisor_stall_probe(tmp_path):
+    import time as _time
+
+    sup = _load_script("supervise")
+    hb = str(tmp_path / "heartbeat.json")
+    args = sup._parse_args(["--heartbeat", hb, "--stall_age", "0.2",
+                            "--", "true"])
+    s = sup.Supervisor(args)
+
+    def beat(**fields):
+        with open(hb, "w") as f:
+            json.dump({"ts": _time.time(), **fields}, f)
+
+    # A beat with no digest fields is never stall-killed: the metrics
+    # plane being off means "unknown", not "stopped progressing".
+    beat(status="running")
+    assert s._progress_stalled() is None
+    _time.sleep(0.3)
+    beat(status="running")
+    assert s._progress_stalled() is None
+    # A moving counter keeps resetting the stall clock.
+    beat(steps_total=10)
+    assert s._progress_stalled() is None  # first sighting arms the probe
+    _time.sleep(0.3)
+    beat(steps_total=11)
+    assert s._progress_stalled() is None  # progressed: clock reset
+    # Frozen counter under a FRESH heartbeat: liveness watching stays
+    # quiet, the progress probe is what reports it.
+    _time.sleep(0.3)
+    beat(steps_total=11)
+    verdict = s._progress_stalled()
+    assert verdict is not None
+    assert verdict["heartbeat"] == hb
+    assert verdict["stalled_s"] >= 0.2
+    assert "steps_total" in verdict["fields"]
+    # Relaunch clears the memory (fresh child restarts its counters):
+    # the same value re-arms instead of insta-killing the new child.
+    s._progress.clear()
+    assert s._progress_stalled() is None
+
+
+def test_supervisor_stall_disabled_by_default(tmp_path):
+    sup = _load_script("supervise")
+    hb = str(tmp_path / "heartbeat.json")
+    args = sup._parse_args(["--heartbeat", hb, "--", "true"])
+    s = sup.Supervisor(args)
+    with open(hb, "w") as f:
+        json.dump({"steps_total": 7}, f)
+    assert args.stall_age == 0.0
+    assert s._progress_stalled() is None

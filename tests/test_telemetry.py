@@ -518,3 +518,153 @@ def test_replicated_scalar_keeps_jit_cache_stable(devices8):
     state = carry(state)
     state = carry(state)
     assert carry._cache_size() == 1
+
+
+# --------------------------------------------------------------------------- #
+# Metrics plane: registry, snapshots, exposition, pump
+# --------------------------------------------------------------------------- #
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry.metrics import (  # noqa: E402, E501
+    MetricsPump,
+    MetricsRegistry,
+    NullRegistry,
+    histogram_quantile,
+    merge_histograms,
+    merge_snapshots,
+    snapshot_to_prometheus,
+    sum_series,
+)
+
+
+def test_registry_instruments_and_atomic_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("served_total", priority="high")
+    # Instruments are cached by (name, labels): call sites re-resolve.
+    assert reg.counter("served_total", priority="high") is c
+    assert reg.counter("served_total", priority="low") is not c
+    c.inc()
+    c.inc(3)
+    g = reg.gauge("queue_depth")
+    g.set(7)
+    g.add(-2)
+    h = reg.histogram("lat_ms", lowest=1.0, growth=2.0, buckets=4)
+    for v in (0.5, 3.0, 100.0):  # first, third, overflow bucket
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["counters"]['served_total{priority="high"}'] == 4.0
+    assert snap["gauges"]["queue_depth"] == 5.0
+    hs = snap["histograms"]["lat_ms"]
+    assert hs["buckets"] == [1, 0, 1, 0, 1] and hs["count"] == 3
+    assert hs["sum"] == pytest.approx(103.5)
+    # Snapshots are plain copies: mutating one never touches the registry.
+    snap["histograms"]["lat_ms"]["buckets"][0] = 99
+    assert reg.snapshot()["histograms"]["lat_ms"]["buckets"][0] == 1
+    # One name, one kind — silently re-typing a series is telemetry drift.
+    with pytest.raises(TypeError):
+        reg.gauge("served_total", priority="high")
+    with pytest.raises(ValueError):
+        reg.histogram("bad", lowest=0.0)
+
+
+def test_histogram_quantile_saturates_at_largest_finite_bound():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", lowest=1.0, growth=2.0, buckets=3)  # 1,2,4,+ovf
+    assert histogram_quantile(reg.snapshot()["histograms"]["lat"], 0.99) == 0.0
+    for v in (1.0, 2.0, 1000.0):
+        h.observe(v)
+    hs = reg.snapshot()["histograms"]["lat"]
+    assert histogram_quantile(hs, 0.5) == 2.0
+    # The overflow bucket must not invent an unbounded estimate.
+    assert histogram_quantile(hs, 0.99) == 4.0
+
+
+def test_merge_snapshots_semantics():
+    a = MetricsRegistry()
+    b = MetricsRegistry()
+    for reg, n, depth, lat in ((a, 3, 5.0, 1.0), (b, 4, 9.0, 64.0)):
+        reg.counter("req_total").inc(n)
+        reg.gauge("depth").set(depth)
+        reg.histogram("lat_ms", lowest=1.0, growth=2.0, buckets=8).observe(lat)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["counters"]["req_total"] == 7.0  # counters sum
+    assert merged["gauges"]["depth"] == 9.0  # gauges last-wins, never add
+    assert merged["histograms"]["lat_ms"]["count"] == 2
+    assert sum_series(merged["counters"], "req_total") == 7.0
+    # Different layouts refuse to merge rather than mangle the ladder.
+    c = MetricsRegistry()
+    c.histogram("lat_ms", lowest=1.0, growth=2.0, buckets=4).observe(1.0)
+    with pytest.raises(ValueError):
+        merge_histograms(merged["histograms"]["lat_ms"],
+                         c.snapshot()["histograms"]["lat_ms"])
+
+
+def test_prometheus_exposition_shape():
+    reg = MetricsRegistry()
+    reg.counter("req_total", priority="high").inc(2)
+    reg.gauge("depth").set(3.5)
+    h = reg.histogram("lat_ms", lowest=1.0, growth=2.0, buckets=2)
+    h.observe(1.0)
+    h.observe(999.0)
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE req_total counter" in lines
+    assert "# TYPE depth gauge" in lines
+    assert "# TYPE lat_ms histogram" in lines
+    assert 'req_total{priority="high"} 2' in lines
+    assert "depth 3.5" in lines
+    # Cumulative buckets with a final +Inf carrying the total count.
+    assert 'lat_ms_bucket{le="1"} 1' in lines
+    assert 'lat_ms_bucket{le="2"} 1' in lines
+    assert 'lat_ms_bucket{le="+Inf"} 2' in lines
+    assert "lat_ms_sum 1000" in lines  # integral sums render exact
+    assert "lat_ms_count 2" in lines
+    assert snapshot_to_prometheus(reg.snapshot()) == text
+
+
+def test_metrics_pump_flushes_schema_valid_records_and_digest(tmp_path):
+    reg = MetricsRegistry()
+    steps = reg.counter("steps_total")
+    reg.histogram("step_latency_ms", lowest=0.5, growth=2.0,
+                  buckets=4).observe(12.0)
+    log = str(tmp_path / "run.jsonl")
+    hb_path = str(tmp_path / "heartbeat.json")
+    hb = Heartbeat(hb_path, interval_s=0.0, process_index=0, process_count=1)
+    sink = JsonlLogger(log)
+    pump = MetricsPump(reg, sink, interval_s=60.0, source="train",
+                       heartbeat=hb)
+    steps.inc(5)
+    pump.flush()
+    time.sleep(0.02)
+    steps.inc(5)
+    pump.stop()  # never started: still joins nothing and flushes the tail
+    recs = [json.loads(line) for line in open(log)]
+    snaps = [r for r in recs if r["type"] == "metrics_snapshot"]
+    assert [s["seq"] for s in snaps] == [1, 2]
+    assert all(s["source"] == "train" for s in snaps)
+    assert snaps[0]["counters"]["steps_total"] == 5.0
+    assert snaps[0]["rates"] == {}  # first flush has no previous sample
+    assert snaps[1]["counters"]["steps_total"] == 10.0
+    assert snaps[1]["rates"]["steps_total"] > 0
+    assert snaps[1]["histograms"]["step_latency_ms"]["count"] == 1
+    # Every flushed record passes the schema lint.
+    m = _load_script("check_telemetry_schema")
+    assert m.check_file(log) == []
+    # The heartbeat carries the progress digest the supervisor's stall
+    # probe watches (absolute counter + rate), not the whole snapshot.
+    beat = read_heartbeat(hb_path, max_age_s=60.0)
+    assert beat["fresh"]
+    assert beat["steps_total"] == 10.0
+    assert "step_rate" in beat
+    assert "serve_requests_total" not in beat  # absent series: no digest
+    hb.stop()
+
+
+def test_null_registry_is_inert():
+    reg = NullRegistry()
+    c = reg.counter("steps_total")
+    c.inc(100)
+    reg.gauge("depth").set(9)
+    reg.histogram("lat").observe(5.0)
+    assert c.value == 0.0
+    assert reg.snapshot() == {"counters": {}, "gauges": {}, "histograms": {}}
+    assert reg.to_prometheus() == ""
