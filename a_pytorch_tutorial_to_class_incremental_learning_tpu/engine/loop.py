@@ -198,7 +198,27 @@ class CilTrainer:
             )
             self.scenario_val, _ = build_scenario(config, train=False)
 
-        dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+        # The run's precision policy (ops/precision.py): --precision wins,
+        # --compute_dtype is its legacy alias.  Resolved once; the model
+        # stack, step builders and provenance records all read this object.
+        from ..ops.precision import policy_from_config
+
+        self.policy = policy_from_config(config)
+        # Persistent XLA compilation cache: with --compile_cache in the
+        # config, arm it before the first trace (model init below compiles).
+        # Guarded so an environment/main.py that already configured the
+        # cache (e.g. a supervised relaunch passing JAX_COMPILATION_CACHE_DIR
+        # through) wins.
+        if config.compile_cache and jax.config.jax_compilation_cache_dir is None:
+            from ..utils.platform import enable_compile_cache
+
+            enable_compile_cache(config.compile_cache)
+        # Compile-cost accounting (telemetry/compilewatch.py): snapshot
+        # deltas around each task's first executed epoch price what every
+        # trace actually cost — and prove a warm-cache resume cost ~nothing.
+        from ..telemetry.compilewatch import CompileWatch
+
+        self._compile_watch = CompileWatch.install()
         # 1-channel pipeline for the mnist backbone family — a family the
         # reference defines but never dispatches (template.py:72-84,
         # resnet.py:127-139); here it runs end-to-end (mnist/synthetic_mnist
@@ -247,11 +267,11 @@ class CilTrainer:
         self.model, variables = create_model(
             config.backbone,
             self.nb_classes,
-            dtype=dtype,
             width_multiple=self.mesh.shape["model"],
             input_size=config.input_size,
             channels=channels,
             bn_group_size=config.bn_group_size,
+            policy=self.policy,
         )
         self.root_key = jax.random.PRNGKey(config.seed)
         init_key, self._grow_key = jax.random.split(
@@ -310,6 +330,7 @@ class CilTrainer:
                 has_teacher=has_teacher,
                 use_pallas_loss=use_pallas,
                 mesh=self.mesh,
+                policy=self.policy,
             )
             for has_teacher in (False, True)
         }
@@ -324,6 +345,7 @@ class CilTrainer:
                 has_teacher=has_teacher,
                 mesh=self.mesh,
                 use_pallas_loss=use_pallas,
+                policy=self.policy,
             )
             for has_teacher in (False, True)
         }
@@ -359,6 +381,9 @@ class CilTrainer:
         self._eval_fresh_shapes = True
         self._feature_fresh_shapes = True
         self._global_step = 0
+        # Next-task dataset warm ring (data/prefetch.py), armed during the
+        # previous task's herd phase; see _warm_next_task.
+        self._task_warm = None
         # Provenance header: committed logs are only evidence if a reader can
         # see exactly what produced them.
         self.jsonl.log(
@@ -375,6 +400,7 @@ class CilTrainer:
             aa=config.aa,
             memory_size=config.memory_size,
             compute_dtype=config.compute_dtype,
+            precision=self.policy.name,
             backend=jax.default_backend(),
             mesh=dict(self.mesh.shape),
             processes=jax.process_count(),
@@ -444,6 +470,11 @@ class CilTrainer:
             with tel.span("fit"):
                 return self._fit_tasks()
         finally:
+            # A warm ring armed for a task that never ran (crash, last
+            # task) must still release its thread and device buffers.
+            if self._task_warm is not None:
+                self._task_warm["prefetcher"].close()
+                self._task_warm = None
             tel.close()
 
     def _fit_tasks(self) -> Dict:
@@ -573,6 +604,11 @@ class CilTrainer:
                 tel.heartbeat.update(force=True, task=task_id, phase="herd")
                 with tel.span("herd", task=task_id):
                     self._update_memory(task_id, task_train)
+                # Memory is final for the next task now: warm-start its
+                # device-resident dataset on the prefetch ring so the H2D
+                # transfer overlaps the checkpoint write and the next task's
+                # host-side setup.
+                self._warm_next_task(task_id)
                 self.known += nb_new
                 with tel.span("checkpoint", task=task_id):
                     self._save_checkpoint(task_id)
@@ -659,7 +695,17 @@ class CilTrainer:
         if fused:
             rep = replicated(self.mesh)
             # Dataset lives in HBM for the whole task (CIFAR-100: 150 MB).
-            data_x, data_y = self._put(task_train.x, task_train.y, sharding=rep)
+            # The previous task's herd phase may have warm-started this
+            # transfer on the prefetch ring (_warm_next_task); a verified
+            # hit hands the device-resident arrays over, a miss falls back
+            # to the synchronous put.
+            warm = self._consume_task_warm(task_id, task_train)
+            if warm is not None:
+                data_x, data_y = warm
+            else:
+                data_x, data_y = self._put(
+                    task_train.x, task_train.y, sharding=rep
+                )
             # One digest per task (not per epoch): the fused program consumes
             # the whole resident dataset, so this is the finest granularity
             # the host ever sees on this path.
@@ -674,6 +720,14 @@ class CilTrainer:
             # Trace the first executed epoch of each task when profiling is
             # on (the later epochs replay the same compiled program).
             profile_here = cfg.profile_dir if epoch == start_epoch else None
+            # A task's first executed epoch carries every (re)compile for
+            # this task's shapes; delta-snapshot the compile watch around it
+            # so the compile_event record prices that cost — and proves a
+            # warm persistent cache drove it to ~0.
+            watch_before = (
+                self._compile_watch.snapshot() if epoch == start_epoch
+                else None
+            )
             t_epoch = time.perf_counter()
             lr = cosine_lr(cfg.lr, epoch, cfg.num_epochs)
             epoch_key = jax.random.fold_in(
@@ -735,6 +789,19 @@ class CilTrainer:
                 task_id=task_id,
                 epoch=epoch + 1,
             )
+            if watch_before is not None:
+                from ..telemetry.compilewatch import CompileWatch
+
+                self.jsonl.log(
+                    "compile_event",
+                    task_id=task_id,
+                    epoch=epoch + 1,
+                    resumed=bool(self.resumed_from is not None
+                                 and task_id == self.start_task),
+                    **CompileWatch.delta(
+                        watch_before, self._compile_watch.snapshot()
+                    ),
+                )
             # epoch_s makes XLA compile cost visible in the evidence log:
             # epoch 1 of a task carries any (re)compile for that task's
             # shapes; steady-state epochs are the pure step cost (r3 Weak #7).
@@ -1102,6 +1169,118 @@ class CilTrainer:
         )
         self._feature_fresh_shapes = False
         self.memory.add(*task_train.get_raw_samples(), features)
+
+    # ------------------------------------------------------------------ #
+    # Next-task dataset warm ring (data/prefetch.py; --prefetch_depth)
+    # ------------------------------------------------------------------ #
+
+    def _warm_next_task(self, task_id: int) -> None:
+        """Arm a depth-1 prefetch ring with the NEXT task's fused dataset.
+
+        Called from the herd phase, when the rehearsal memory is final for
+        task ``task_id + 1``: the next task's injected dataset (task slice +
+        exemplars) is reconstructed here and its replicated ``device_put``
+        runs on the ring's producer thread, overlapping the checkpoint write
+        and the next task's host-side setup.  Consumption
+        (:meth:`_consume_task_warm`) verifies the warmed content against the
+        dataset the task loop actually built — a mismatch is a logged miss
+        that falls back to the synchronous put, never wrong data.
+
+        Gated exactly like the async input pipeline (``--prefetch_depth``)
+        and only useful on the fused-epoch path (the per-batch path streams
+        its batches through its own ring already).
+        """
+        cfg = self.config
+        nxt = task_id + 1
+        if (cfg.prefetch_depth <= 0 or not cfg.fused_epochs
+                or nxt >= len(self.scenario_train)):
+            return
+        warm_train = self.scenario_train[nxt]
+        if nxt > 0:
+            warm_train.add_samples(*self.memory.get())
+        if warm_train.x.dtype != np.uint8:
+            return  # lazy path-based dataset: stays on the per-batch loop
+        rep = replicated(self.mesh)
+        stride = max(1, len(warm_train.x) // 8)
+        t0 = time.perf_counter()
+
+        def _place(host):
+            hx, hy = host
+            return self._put(hx, hy, sharding=rep)
+
+        self._task_warm = {
+            "task_id": nxt,
+            "prefetcher": DevicePrefetcher(
+                iter([(warm_train.x, warm_train.y)]),
+                _place,
+                depth=1,
+                name=f"prefetch-taskwarm-t{nxt}",
+                metrics=self.telemetry.metrics,
+            ),
+            "t0": t0,
+            "y": warm_train.y,
+            "x_probe": warm_train.x[::stride].copy(),
+            "probe_stride": stride,
+            "nbytes": int(warm_train.x.nbytes + warm_train.y.nbytes),
+        }
+
+    def _consume_task_warm(self, task_id: int, task_train):
+        """Hand over the warmed device arrays iff they match ``task_train``.
+
+        Verification is labels-exact plus a strided pixel probe: the labels
+        array is tiny and the probe covers every region of the concatenated
+        (slice + exemplars) buffer, so any divergence in task slicing or
+        memory content surfaces as a miss.  Every outcome emits a
+        ``prefetch_warm`` record; the warm path can degrade but never
+        propagate an exception into training.
+        """
+        warm, self._task_warm = self._task_warm, None
+        if warm is None:
+            return None
+        pf = warm["prefetcher"]
+        try:
+            if warm["task_id"] != task_id:
+                pf.close()
+                self.jsonl.log(
+                    "prefetch_warm", task_id=task_id, hit=False,
+                    reason=f"armed_for_task{warm['task_id']}",
+                )
+                return None
+            stride = warm["probe_stride"]
+            matches = (
+                task_train.x.dtype == np.uint8
+                and np.array_equal(warm["y"], task_train.y)
+                and np.array_equal(warm["x_probe"], task_train.x[::stride])
+            )
+            if not matches:
+                pf.close()
+                self.jsonl.log(
+                    "prefetch_warm", task_id=task_id, hit=False,
+                    reason="content_mismatch",
+                )
+                return None
+            t_wait = time.perf_counter()
+            placed = next(pf, None)
+            pf.close()
+            if placed is None:
+                self.jsonl.log(
+                    "prefetch_warm", task_id=task_id, hit=False,
+                    reason="ring_empty",
+                )
+                return None
+            self.jsonl.log(
+                "prefetch_warm", task_id=task_id, hit=True,
+                bytes=warm["nbytes"],
+                wait_s=round(time.perf_counter() - t_wait, 4),
+                warm_s=round(time.perf_counter() - warm["t0"], 4),
+            )
+            return placed
+        except Exception as e:  # noqa: BLE001 — warm path must not kill a run
+            pf.close()
+            self.jsonl.log(
+                "prefetch_warm", task_id=task_id, hit=False, reason=repr(e),
+            )
+            return None
 
     # ------------------------------------------------------------------ #
     # Checkpointing hook (filled in by utils.checkpoint; no-op default)

@@ -33,7 +33,12 @@ def cross_entropy(
     of (active) classes.  Masked columns hold NEG_INF, so ``log_softmax`` over
     the full width already matches a softmax over the active slice; the
     smoothing term is summed over active columns only.
+
+    The accumulation runs in f32 regardless of the model's precision policy
+    (ops/precision.LOSS_DTYPE): logits are upcast at entry, so a bf16 caller
+    cannot silently shift the loss numerics.
     """
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     if label_smoothing:
@@ -57,7 +62,14 @@ def soft_target_kd(
     ``KL(log_softmax(s/T) || softmax(t/T)) * T^2``, batchmean reduction, over
     the first ``known`` classes (the ``logits[:, :known]`` slice,
     ``template.py:263``).  Teacher logits are already masked to ``known``.
+
+    KD is the numerically fragile half of WA's loss (temperature-scaled
+    softmax over near-ties); both operand sets are upcast to f32 at entry
+    (ops/precision.LOSS_DTYPE) so the divergence accumulates in f32 under
+    every precision policy.
     """
+    student_logits = student_logits.astype(jnp.float32)
+    teacher_logits = teacher_logits.astype(jnp.float32)
     width = student_logits.shape[-1]
     mask = _active_mask(width, known)
     neg = jnp.float32(-1e9)

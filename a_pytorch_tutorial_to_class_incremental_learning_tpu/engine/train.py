@@ -114,6 +114,7 @@ def _make_step_core(
     has_teacher: bool,
     use_pallas_loss: bool = False,
     mesh=None,
+    policy=None,
 ):
     """The un-jitted train-step body shared by the per-step and fused-epoch
     paths: augment -> student forward (+ teacher forward) -> CE+λKD ->
@@ -126,6 +127,15 @@ def _make_step_core(
     # not auto-partitionable) — one fused pass per batch stripe.
     backend = jax.default_backend()
     pallas_loss = use_pallas_loss and backend in ("tpu", "cpu")
+    if pallas_loss and policy is not None:
+        # Custom kernels must opt into the run's precision policy
+        # (ops/precision registry); an unregistered combination falls back
+        # to the XLA loss instead of silently running unvalidated numerics.
+        from ..ops.precision import kernel_policy_compatible
+
+        pallas_loss = kernel_policy_compatible(
+            "fused_masked_cross_entropy", policy
+        )
     pallas_sharded = pallas_loss and mesh is not None and mesh.size > 1
 
     # jax.named_scope threads the phase names into XLA metadata, so device
@@ -221,6 +231,7 @@ def make_train_step(
     has_teacher: bool,
     use_pallas_loss: bool = False,
     mesh=None,
+    policy=None,
 ):
     """Build the jitted per-batch train step.
 
@@ -242,6 +253,7 @@ def make_train_step(
         has_teacher,
         use_pallas_loss,
         mesh,
+        policy,
     )
     return jax.jit(step, donate_argnums=(0,))
 
@@ -256,6 +268,7 @@ def make_epoch_fn(
     has_teacher: bool,
     mesh,
     use_pallas_loss: bool = False,
+    policy=None,
 ):
     """Build the fused-epoch program: shuffle + gather + every train step of
     an epoch as ONE compiled ``lax.scan``.
@@ -287,6 +300,7 @@ def make_epoch_fn(
         has_teacher,
         use_pallas_loss,
         mesh,
+        policy,
     )
 
     def epoch(

@@ -39,10 +39,15 @@ class CilModel(nn.Module):
     width: int = 100
     dtype: Any = jnp.float32
     bn_group_size: int = 0  # reference per-replica BN parity (models/norm.py)
+    # Selective-precision knobs (ops/precision.py); None = same as dtype /
+    # f32 head, which reproduces the pre-policy behavior exactly.
+    act_dtype: Any = None
+    head_dtype: Any = None
 
     def setup(self):
         self.backbone = get_backbone(
-            self.backbone_name, dtype=self.dtype, bn_group_size=self.bn_group_size
+            self.backbone_name, dtype=self.dtype,
+            bn_group_size=self.bn_group_size, act_dtype=self.act_dtype,
         )
         # Allocated zero; live columns are filled per task by `grow` with the
         # torch-Linear-equivalent init (classifier.py).
@@ -64,7 +69,7 @@ class CilModel(nn.Module):
         """
         feats = self.backbone(x, train=train)
         fc = {"kernel": self.fc_kernel, "bias": self.fc_bias}
-        return masked_logits(feats, fc, num_active), feats
+        return masked_logits(feats, fc, num_active, self.head_dtype), feats
 
     def extract_vector(self, x: jax.Array, train: bool = False) -> jax.Array:
         """Backbone features only (reference ``template.py:117-118``)."""
@@ -88,17 +93,28 @@ def create_model(
     input_size: int = 32,
     channels: int = 3,
     bn_group_size: int = 0,
+    policy=None,
 ) -> Tuple[CilModel, dict]:
     """Build the module and its zero-head variables.
 
     Returns ``(model, variables)`` where ``variables`` holds ``params`` and
     ``batch_stats``.  The head starts fully inactive (``num_active=0``);
     :func:`grow` activates column ranges per task.
+
+    ``policy`` (ops/precision.Policy) supersedes the bare ``dtype``: it sets
+    the conv compute dtype plus the selective activation/head dtypes.  The
+    bare ``dtype`` path is kept for callers predating the policy layer.
     """
+    if policy is not None:
+        dtype = policy.compute_dtype
+        act_dtype, head_dtype = policy.act_dtype, policy.head_dtype
+    else:
+        act_dtype = head_dtype = None
     width = round_up(nb_classes, max(width_multiple, 1))
     model = CilModel(
         backbone_name=backbone_name, width=width, dtype=dtype,
-        bn_group_size=bn_group_size,
+        bn_group_size=bn_group_size, act_dtype=act_dtype,
+        head_dtype=head_dtype,
     )
     dummy = jnp.zeros((1, input_size, input_size, channels), jnp.float32)
     variables = model.init(

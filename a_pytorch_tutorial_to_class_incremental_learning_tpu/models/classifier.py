@@ -79,14 +79,28 @@ def grow_head(
 
 
 def masked_logits(
-    features: jax.Array, fc_params: dict, num_active: jax.Array
+    features: jax.Array, fc_params: dict, num_active: jax.Array,
+    head_dtype=None,
 ) -> jax.Array:
     """``[B, feat] -> [B, width]`` logits with columns >= num_active masked.
 
     The concat-of-heads forward (reference ``template.py:99-101``) collapses
     to one MXU-friendly matmul; masking replaces shape growth.
+
+    ``head_dtype`` (ops/precision.py) casts the matmul *operands* — the f32
+    master kernel is cast at the contraction boundary, never in the parameter
+    store — while ``preferred_element_type`` keeps the accumulation and the
+    logits themselves f32 (the policy layer's ``LOGITS_DTYPE`` contract: WA's
+    alignment and the KD loss read these).
     """
-    logits = features @ fc_params["kernel"] + fc_params["bias"]
+    if head_dtype is not None and jnp.dtype(head_dtype) != jnp.float32:
+        logits = jnp.matmul(
+            features.astype(head_dtype),
+            fc_params["kernel"].astype(head_dtype),
+            preferred_element_type=jnp.float32,
+        ) + fc_params["bias"]
+    else:
+        logits = features @ fc_params["kernel"] + fc_params["bias"]
     mask = jnp.arange(logits.shape[-1]) < num_active
     return jnp.where(mask, logits, NEG_INF)
 

@@ -22,6 +22,14 @@ TPU-first design notes (not a port):
   (SURVEY.md §7 item 2).
 * ``compute_dtype`` allows bfloat16 activations so convs land on the MXU in
   its native precision; parameters and BN statistics stay float32.
+* ``act_dtype`` (ops/precision.py) decouples the inter-op activation dtype
+  from the conv compute dtype: under the ``bf16_selective`` policy convs
+  compute in bf16 (operands cast at the matmul boundary by Flax's
+  ``promote_dtype``) but their outputs are cast back to f32, so BatchNorm
+  arithmetic, ReLU, residual adds and the average pool all run in f32.
+  ``act_dtype=None`` means "same as dtype", which makes every new cast a
+  no-op and keeps the ``f32``/``bf16_all`` presets bit-identical to the
+  pre-policy behavior.
 """
 
 from __future__ import annotations
@@ -93,9 +101,11 @@ class BasicBlock(nn.Module):
     downsample: bool = False
     dtype: Any = jnp.float32
     bn_group_size: int = 0
+    act_dtype: Any = None  # None = same as dtype (casts below are no-ops)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        act = self.dtype if self.act_dtype is None else self.act_dtype
         residual = x
         y = nn.Conv(
             self.planes,
@@ -107,7 +117,7 @@ class BasicBlock(nn.Module):
             dtype=self.dtype,
             name="conv_a",
         )(x)
-        y = _norm(self.bn_group_size, train, self.dtype, "bn_a")(y)
+        y = _norm(self.bn_group_size, train, act, "bn_a")(y.astype(act))
         y = nn.relu(y)
         y = nn.Conv(
             self.planes,
@@ -119,7 +129,7 @@ class BasicBlock(nn.Module):
             dtype=self.dtype,
             name="conv_b",
         )(y)
-        y = _norm(self.bn_group_size, train, self.dtype, "bn_b")(y)
+        y = _norm(self.bn_group_size, train, act, "bn_b")(y.astype(act))
         if self.downsample:
             residual = DownsampleA(name="shortcut")(x)
         return nn.relu(residual + y)
@@ -137,6 +147,7 @@ class CifarResNet(nn.Module):
     channels: int = 3  # 1 for the MNIST variants (reference resnet.py:127-139)
     dtype: Any = jnp.float32
     bn_group_size: int = 0  # 0 = global-batch BN; e.g. 128 = per-replica parity
+    act_dtype: Any = None  # inter-op activation dtype; None = same as dtype
 
     @property
     def out_dim(self) -> int:
@@ -148,8 +159,9 @@ class CifarResNet(nn.Module):
         assert x.shape[-1] == self.channels, (
             f"expected {self.channels}-channel input (NHWC), got shape {x.shape}"
         )
+        act = self.dtype if self.act_dtype is None else self.act_dtype
         n = (self.depth - 2) // 6
-        x = x.astype(self.dtype)
+        x = x.astype(act)
         x = nn.Conv(
             16,
             (3, 3),
@@ -160,7 +172,7 @@ class CifarResNet(nn.Module):
             dtype=self.dtype,
             name="conv_1_3x3",
         )(x)
-        x = _norm(self.bn_group_size, train, self.dtype, "bn_1")(x)
+        x = _norm(self.bn_group_size, train, act, "bn_1")(x.astype(act))
         x = nn.relu(x)
         for stage, (planes, stride) in enumerate(((16, 1), (32, 2), (64, 2)), start=1):
             for i in range(n):
@@ -171,6 +183,7 @@ class CifarResNet(nn.Module):
                     downsample=first and stage > 1,
                     dtype=self.dtype,
                     bn_group_size=self.bn_group_size,
+                    act_dtype=self.act_dtype,
                     name=f"stage_{stage}_block_{i}",
                 )(x, train=train)
         # Global 8x8 average pool + flatten -> [B, 64] feature vector
@@ -180,10 +193,12 @@ class CifarResNet(nn.Module):
 
 
 def _factory(depth: int, channels: int = 3) -> Callable[..., CifarResNet]:
-    def make(dtype: Any = jnp.float32, bn_group_size: int = 0) -> CifarResNet:
+    def make(
+        dtype: Any = jnp.float32, bn_group_size: int = 0, act_dtype: Any = None
+    ) -> CifarResNet:
         return CifarResNet(
             depth=depth, channels=channels, dtype=dtype,
-            bn_group_size=bn_group_size,
+            bn_group_size=bn_group_size, act_dtype=act_dtype,
         )
 
     return make
@@ -213,10 +228,13 @@ _BACKBONES = {
 
 
 def get_backbone(
-    name: str, dtype: Any = jnp.float32, bn_group_size: int = 0
+    name: str, dtype: Any = jnp.float32, bn_group_size: int = 0,
+    act_dtype: Any = None,
 ) -> CifarResNet:
     """Flag-string -> backbone module (reference ``template.py:72-84``)."""
     try:
-        return _BACKBONES[name](dtype=dtype, bn_group_size=bn_group_size)
+        return _BACKBONES[name](
+            dtype=dtype, bn_group_size=bn_group_size, act_dtype=act_dtype
+        )
     except KeyError:
         raise NotImplementedError(f"Unknown backbone {name}") from None

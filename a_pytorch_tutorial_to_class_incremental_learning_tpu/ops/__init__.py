@@ -10,3 +10,11 @@ from .fused_loss import (  # noqa: F401
     fused_masked_cross_entropy,
     sharded_fused_masked_cross_entropy,
 )
+from .precision import (  # noqa: F401
+    PRESETS,
+    Policy,
+    get_policy,
+    kernel_policy_compatible,
+    policy_from_config,
+    register_policy_kernel,
+)

@@ -222,3 +222,16 @@ def sharded_fused_masked_cross_entropy(
         out_specs=P(),
         check_vma=False,  # pallas_call has no replication rule
     )(logits, labels, num_active)
+
+
+# The kernel casts its logits to f32 at entry (``_fwd``/``_bwd`` pad in f32)
+# and accumulates the loss in f32 — the ops/precision LOSS_DTYPE contract —
+# so it is numerically valid under every preset, including bf16_selective
+# where the surrounding matmuls run bf16.  Registration keeps the
+# armed-but-optional kernel priced into the policy layer (engine/train.py
+# consults this before enabling the Pallas path).
+from .precision import register_policy_kernel  # noqa: E402
+
+register_policy_kernel(
+    "fused_masked_cross_entropy", "f32", "bf16_all", "bf16_selective"
+)

@@ -1,0 +1,162 @@
+"""Selective mixed-precision policy layer (ROADMAP item 3).
+
+One :class:`Policy` object names the three dtype knobs the model/engine stack
+actually has, instead of the single ``--compute_dtype`` blanket:
+
+* ``compute_dtype`` — the dtype conv/matmul *compute* runs in (the Flax module
+  ``dtype``: ``promote_dtype`` casts operands at the op boundary, so on TPU a
+  bf16 compute_dtype lands the contraction on the MXU in its native precision).
+* ``act_dtype`` — the dtype activations *flow between ops* in.  With
+  ``act_dtype == float32`` every conv output is cast back up, so the
+  numerically sensitive pointwise work (BatchNorm arithmetic, ReLU, residual
+  adds, average pooling) accumulates in f32 while the matmuls stay bf16.
+* ``head_dtype`` — the operand dtype of the classifier head matmul.  The
+  output (logits) is always accumulated to f32 via ``preferred_element_type``.
+
+Everything else is **not** a knob; it is the policy layer's contract,
+regardless of preset:
+
+* master parameters and optimizer momentum are float32 (``PARAM_DTYPE``) —
+  Flax params are created f32 and the SGD update never downcasts them;
+* BatchNorm running statistics are float32 (``STAT_DTYPE``);
+* logits handed to the losses are float32 (``LOGITS_DTYPE``);
+* the CE / KD loss accumulation is float32 (``LOSS_DTYPE``) — WA's knowledge
+  distillation term (arXiv:1911.07053) divides by a temperature-scaled
+  softmax, exactly the place bf16's 8-bit mantissa visibly hurts.
+
+Presets
+-------
+``f32``
+    Everything float32.  The accuracy reference.
+``bf16_all``
+    The pre-policy ``--compute_dtype bfloat16`` behavior, bit-for-bit:
+    compute *and* activations bf16 (so BN arithmetic, residual adds and
+    pooling all round to bf16 between ops).  Measured ~7 points of average
+    incremental accuracy below f32 on the synthetic_hard128 protocol
+    (RESULTS.md) — kept as a named preset precisely so that cost stays
+    priced, not as a recommendation.
+``bf16_selective``
+    The default candidate: bf16 conv/matmul compute and a bf16 head matmul
+    (with f32 accumulation), f32 everything else.  Casts are applied at the
+    matmul boundary, not the parameter store — params stay f32 and each
+    compiled program casts them on the way into the contraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, FrozenSet
+
+import jax.numpy as jnp
+
+# The policy layer's fixed points (see module docstring).  These are
+# deliberately constants, not Policy fields: a preset that downcast any of
+# them would be the exact hazard jaxlint JL104 exists to flag.
+PARAM_DTYPE = jnp.float32   # master params + optimizer momentum
+STAT_DTYPE = jnp.float32    # BatchNorm running statistics
+LOGITS_DTYPE = jnp.float32  # logits as seen by the losses
+LOSS_DTYPE = jnp.float32    # CE / KD accumulation
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A named selective-precision configuration (see module docstring)."""
+
+    name: str
+    compute_dtype: Any  # conv/matmul compute (Flax module dtype)
+    act_dtype: Any      # inter-op activation flow
+    head_dtype: Any     # classifier head matmul operands
+
+    @property
+    def jax_compute_dtype(self):
+        return self.compute_dtype
+
+    def describe(self) -> Dict[str, str]:
+        """JSON-friendly summary for telemetry/provenance records."""
+        return {
+            "name": self.name,
+            "compute_dtype": jnp.dtype(self.compute_dtype).name,
+            "act_dtype": jnp.dtype(self.act_dtype).name,
+            "head_dtype": jnp.dtype(self.head_dtype).name,
+            "param_dtype": jnp.dtype(PARAM_DTYPE).name,
+            "logits_dtype": jnp.dtype(LOGITS_DTYPE).name,
+        }
+
+
+PRESETS: Dict[str, Policy] = {
+    "f32": Policy(
+        "f32",
+        compute_dtype=jnp.float32, act_dtype=jnp.float32,
+        head_dtype=jnp.float32,
+    ),
+    "bf16_all": Policy(
+        "bf16_all",
+        compute_dtype=jnp.bfloat16, act_dtype=jnp.bfloat16,
+        head_dtype=jnp.float32,
+    ),
+    "bf16_selective": Policy(
+        "bf16_selective",
+        compute_dtype=jnp.bfloat16, act_dtype=jnp.float32,
+        head_dtype=jnp.bfloat16,
+    ),
+}
+
+# --compute_dtype is kept as an alias flag (config.py); these are its two
+# legal values mapped onto the preset table.
+_COMPUTE_DTYPE_ALIASES = {
+    "float32": "f32",
+    "bfloat16": "bf16_all",
+}
+
+
+def get_policy(name: str) -> Policy:
+    """Preset name (or ``--compute_dtype`` alias) -> :class:`Policy`."""
+    key = _COMPUTE_DTYPE_ALIASES.get(name, name)
+    try:
+        return PRESETS[key]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {name!r}; "
+            f"choose from {sorted(PRESETS)}"
+        ) from None
+
+
+def policy_from_config(config) -> Policy:
+    """Resolve the run's policy from a CilConfig (or anything duck-typed).
+
+    ``--precision`` wins when set; otherwise the legacy ``--compute_dtype``
+    alias keeps old command lines and checkpointed configs working.
+    """
+    precision = getattr(config, "precision", "") or ""
+    if precision:
+        return get_policy(precision)
+    return get_policy(getattr(config, "compute_dtype", "float32"))
+
+
+# --------------------------------------------------------------------------- #
+# Policy-compatible kernel registry
+# --------------------------------------------------------------------------- #
+# Custom kernels (Pallas and friends) opt in per policy: a kernel is
+# *policy-compatible* when its numerics honor the contract above (f32 loss
+# accumulation over f32 logits) under that policy's activation/compute dtypes.
+# The registry keeps the armed-but-unused kernels honest — bench/tests consult
+# it instead of assuming.
+
+_KERNEL_REGISTRY: Dict[str, FrozenSet[str]] = {}
+
+
+def register_policy_kernel(kernel_name: str, *policy_names: str) -> None:
+    """Declare ``kernel_name`` numerically valid under the named presets."""
+    for p in policy_names:
+        if p not in PRESETS:
+            raise ValueError(f"unknown policy {p!r} for kernel {kernel_name!r}")
+    _KERNEL_REGISTRY[kernel_name] = frozenset(policy_names)
+
+
+def kernel_policies(kernel_name: str) -> FrozenSet[str]:
+    """The policies a kernel is registered for (empty set = unregistered)."""
+    return _KERNEL_REGISTRY.get(kernel_name, frozenset())
+
+
+def kernel_policy_compatible(kernel_name: str, policy: Policy) -> bool:
+    return policy.name in _KERNEL_REGISTRY.get(kernel_name, frozenset())

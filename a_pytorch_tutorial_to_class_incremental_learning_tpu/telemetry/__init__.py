@@ -26,6 +26,7 @@ from .cil_metrics import (  # noqa: F401
     backward_transfer,
     per_task_forgetting,
 )
+from .compilewatch import CompileWatch  # noqa: F401
 from .counters import RecompileMonitor, StallClock, clocked, hbm_stats  # noqa: F401
 from .flight import FlightRecorder, FlightSink  # noqa: F401
 from .heartbeat import Heartbeat, read_heartbeat  # noqa: F401

@@ -1,0 +1,91 @@
+"""Compile-time accounting via ``jax.monitoring`` duration events.
+
+``RecompileMonitor`` (telemetry/counters.py) counts *traces* — how many times
+a jitted wrapper's cache grew.  This module prices what each trace actually
+*cost*: XLA fires a ``/jax/core/compile/backend_compile_duration`` event for
+every backend compilation, and — when the persistent compilation cache
+(utils/platform.enable_compile_cache) serves the executable — an additional
+``/jax/compilation_cache/cache_retrieval_time_sec`` event whose duration is
+essentially the whole "compile".  The real XLA work of a window is therefore
+
+    compile_s  =  Σ backend_compile_duration  −  Σ cache_retrieval_time
+
+which is ≈0 for a warm-cache resume: that number is what the ``compile_event``
+telemetry record carries per task-growth event and what
+``scripts/perf_gate.py --compile`` gates against BASELINE.json.
+
+``jax.monitoring`` listeners cannot be unregistered, so the watch is a
+process-wide singleton; readers take :meth:`snapshot` deltas around the
+window they care about (task's first epoch, artifact AOT load, ...).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_CACHE_RETRIEVAL = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+
+class CompileWatch:
+    """Process-wide accumulator of XLA compile / cache-retrieval durations."""
+
+    _instance: Optional["CompileWatch"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.backend_compile_s = 0.0
+        self.cache_retrieval_s = 0.0
+        self.compiles = 0
+        self.cache_hits = 0
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(self._on_event)
+
+    @classmethod
+    def install(cls) -> "CompileWatch":
+        """Idempotent: one listener per process, however many callers."""
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # Listener signature: (event_name, duration_secs, **kwargs).  Never raise
+    # from here — this runs inside every jit compile in the process.
+    def _on_event(self, event: str, duration: float, **_kw) -> None:
+        with self._lock:
+            if event == _BACKEND_COMPILE:
+                self.backend_compile_s += float(duration)
+                self.compiles += 1
+            elif event == _CACHE_RETRIEVAL:
+                self.cache_retrieval_s += float(duration)
+                self.cache_hits += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "backend_compile_s": self.backend_compile_s,
+                "cache_retrieval_s": self.cache_retrieval_s,
+                "compiles": self.compiles,
+                "cache_hits": self.cache_hits,
+            }
+
+    @staticmethod
+    def delta(before: Dict[str, float], after: Dict[str, float]) -> Dict[str, float]:
+        """Window accounting between two snapshots.
+
+        ``compile_s`` is the net XLA work: backend time minus the share the
+        persistent cache served (clamped at 0 — retrieval bookkeeping can
+        slightly exceed the reported backend duration on a fully warm load).
+        """
+        backend = after["backend_compile_s"] - before["backend_compile_s"]
+        retrieval = after["cache_retrieval_s"] - before["cache_retrieval_s"]
+        return {
+            "compile_s": round(max(0.0, backend - retrieval), 4),
+            "backend_compile_s": round(backend, 4),
+            "cache_retrieval_s": round(retrieval, 4),
+            "compiles": int(after["compiles"] - before["compiles"]),
+            "cache_hits": int(after["cache_hits"] - before["cache_hits"]),
+        }

@@ -20,6 +20,13 @@ Rule catalog (see README "Static analysis"):
   (``x[:n]``, ``batch[i:j]``) — every distinct length is a new input shape,
   so XLA silently recompiles per iteration (the classic ragged-final-batch
   leak).  Constant bounds (``x[:64]``, ``x[:-1]``) are static and allowed.
+* JL104 — f32 master state cast to bf16: ``.astype(jnp.bfloat16)`` /
+  ``asarray(..., bfloat16)`` / ``convert_element_type`` (directly or through
+  a ``tree_map`` lambda) applied to optimizer state (momentum/velocity/
+  opt_state), BN statistics (batch_stats/running_*/ra_*), or a loss
+  accumulator.  The selective-precision contract (ops/precision.py) keeps
+  the master copies in f32 and casts at the matmul boundary; down-casting
+  the store itself accumulates rounding error every update.
 * JL201 — host sync in a device hot loop: ``.item()`` / ``float()`` /
   ``np.asarray`` / ``jax.device_get`` inside a ``for ... in <batches>`` loop.
 * JL301 — thread-shared state: a ``self.*`` attribute written by both the
@@ -65,6 +72,7 @@ RULES: Dict[str, str] = {
     "JL101": "uncommitted Python scalar where replicated_scalar is required",
     "JL102": "branch on a traced value inside a jitted function",
     "JL103": "non-constant slice fed to a jitted program inside a loop",
+    "JL104": "f32 master state (optimizer/BN stats/loss accumulator) cast to bf16",
     "JL201": "host sync inside a device hot loop",
     "JL301": "attribute written by producer thread and consumer outside the lock",
     "JL302": "over-broad except handler silently swallows the error",
@@ -696,6 +704,106 @@ def _uncommitted(v: ast.expr) -> bool:
 
 
 # --------------------------------------------------------------------------- #
+# JL104: f32 master state cast down to bf16
+# --------------------------------------------------------------------------- #
+
+# Name fragments that mark a binding as f32-master state under the selective
+# mixed-precision contract (ops/precision.py): optimizer slots, BN statistics,
+# loss accumulators.  Matching is substring-on-lowercased-dotted-name — the
+# same deliberately name-based precision as JL101/JL301.
+_F32_MASTER_TOKENS = (
+    "momentum", "velocity", "opt_state",
+    "batch_stats", "running_mean", "running_var", "ra_mean", "ra_var",
+    "loss",
+)
+_BF16_NAMES = ("bfloat16", "bf16")
+_CAST_FUNCS = ("asarray", "array", "convert_element_type",
+               "full_like", "zeros_like", "ones_like")
+
+
+def _is_bf16_dtype(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _BF16_NAMES
+    return (dotted(node) or "").split(".")[-1] in _BF16_NAMES
+
+
+def _master_token(name: Optional[str]) -> Optional[str]:
+    low = (name or "").lower()
+    for tok in _F32_MASTER_TOKENS:
+        if tok in low:
+            return tok
+    return None
+
+
+def _cast_dtype_arg(call: ast.Call) -> Optional[ast.expr]:
+    """The dtype operand of an ``asarray``/``convert_element_type``-style
+    call: second positional or ``dtype=`` / ``new_dtype=`` keyword."""
+    for kw in call.keywords:
+        if kw.arg in ("dtype", "new_dtype"):
+            return kw.value
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _casts_to_bf16(fn: ast.expr) -> bool:
+    """Does this (lambda/def-referenced) expression body cast to bf16?"""
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        if isinstance(sub.func, ast.Attribute) and sub.func.attr == "astype" \
+                and sub.args and _is_bf16_dtype(sub.args[0]):
+            return True
+        if (dotted(sub.func) or "").split(".")[-1] in _CAST_FUNCS:
+            dt = _cast_dtype_arg(sub)
+            if dt is not None and _is_bf16_dtype(dt):
+                return True
+    return False
+
+
+def run_master_cast(path: str, tree: ast.Module, out: List[Finding]) -> None:
+    def flag(node: ast.AST, name: str, tok: str) -> None:
+        out.append(Finding(
+            path, node.lineno, node.col_offset, "JL104",
+            f"`{name}` looks like f32 master state ({tok}) but is cast to "
+            "bfloat16: optimizer slots, BN statistics and loss accumulators "
+            "must stay float32 under selective mixed precision "
+            "(ops/precision.py) — cast activations/weights at the matmul "
+            "boundary instead, or suppress with a reasoned "
+            "`# jaxlint: disable=JL104`",
+        ))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        short = (dotted(node.func) or "").split(".")[-1]
+        # x.astype(bf16) on a guarded name
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+                and node.args and _is_bf16_dtype(node.args[0]):
+            name = dotted(node.func.value)
+            tok = _master_token(name)
+            if tok:
+                flag(node, name, tok)
+        # asarray/convert_element_type(x, bf16) on a guarded name
+        elif short in _CAST_FUNCS:
+            dt = _cast_dtype_arg(node)
+            if dt is not None and _is_bf16_dtype(dt) and node.args:
+                name = dotted(node.args[0])
+                tok = _master_token(name)
+                if tok:
+                    flag(node, name, tok)
+        # tree_map(lambda t: t.astype(bf16), guarded_tree)
+        elif short == "tree_map" and node.args \
+                and _casts_to_bf16(node.args[0]):
+            for arg in node.args[1:]:
+                name = dotted(arg)
+                tok = _master_token(name)
+                if tok:
+                    flag(node, name, tok)
+                    break
+
+
+# --------------------------------------------------------------------------- #
 # JL102: branch-on-tracer inside jitted functions
 # --------------------------------------------------------------------------- #
 
@@ -1081,6 +1189,7 @@ def run_rules(path: str, tree: ast.Module, index: ProjectIndex) -> List[Finding]
     out: List[Finding] = []
     DonationPass(path, tree, index, out).run()
     run_scalar_commit(path, tree, out)
+    run_master_cast(path, tree, out)
     run_branch_on_tracer(path, tree, out)
     run_shape_poly(path, tree, index, out)
     run_host_sync(path, tree, out)

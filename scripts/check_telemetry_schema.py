@@ -168,6 +168,36 @@ SCHEMA = {
         {"group": str, "task_id": NUM},
         None,
     ),
+    # Compile-cost accounting (telemetry/compilewatch.py): net XLA work in a
+    # window — a task's first executed epoch (engine/loop.py) or a serving
+    # replica's AOT load (serving/replica.py, source="replica").  compile_s
+    # is backend compile time minus the share the persistent compilation
+    # cache served; ≈0 on a warm-cache resume, which is what
+    # scripts/perf_gate.py --compile and scripts/warmcache_smoke.py assert.
+    "compile_event": (
+        {"task_id": NUM, "compile_s": NUM, "backend_compile_s": NUM,
+         "cache_retrieval_s": NUM, "compiles": NUM, "cache_hits": NUM},
+        {"epoch": NUM, "resumed": bool, "source": str},
+        None,
+    ),
+    # Next-task device warm-start (engine/loop.py _warm_next_task): outcome
+    # of consuming the ring armed during the previous task's eval/herd
+    # window.  hit=True carries the placed bytes + how long the consumer
+    # waited; hit=False carries why the warm path degraded to the
+    # synchronous transfer (never fatal).
+    "prefetch_warm": (
+        {"task_id": NUM, "hit": bool},
+        {"reason": str, "bytes": NUM, "wait_s": NUM, "warm_s": NUM},
+        None,
+    ),
+    # bench.py --precision sweep: one record per run with a per-preset row
+    # (step_ms, loss_finite, short accuracy probe) under `results`.
+    "precision_ablation": (
+        {"results": list},
+        {"backend": str, "global_batch": NUM, "iters": NUM, "metric": str,
+         "selective_not_slower": bool, "reduced_cpu_fallback": bool},
+        None,
+    ),
     # A fresh (non-resume) run archived the previous soak's spent fire
     # ledger so the --fault_spec re-armed (faults.rotate_ledger).
     "fault_ledger_rotated": ({"path": str, "archived": str}, {}, None),

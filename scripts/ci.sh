@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # One-shot CI: static analysis first (jaxlint, then ruff/mypy when they are
 # installed), telemetry-schema lint over the committed evidence logs, a CPU
-# prefetch determinism smoke, the chaos + lockstep + serving smokes (single-server
-# and replicated fleet), the perf-regression gates (train step, serving p99, and fleet p99
+# prefetch determinism smoke, the chaos + warm-cache + lockstep + serving
+# smokes (single-server and replicated fleet), the perf-regression gates
+# (train step, warm-cache compile cost, serving p99, and fleet p99
 # under overload), then the tier-1 test suite (the exact
 # ROADMAP.md command).  Run from anywhere:
 #
@@ -12,14 +13,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/14: jaxlint (JAX-hazard + lock-discipline static analysis) =="
+echo "== stage 1/16: jaxlint (JAX-hazard + lock-discipline static analysis) =="
 # Fails on any finding not in analysis/jaxlint_baseline.json, and
 # (--check-baseline) on any baseline entry that no longer matches a live
 # finding — suppressions must not rot.  After fixing or justifying
 # findings, refresh with: python scripts/jaxlint.py --write-baseline
 python scripts/jaxlint.py --check-baseline || exit 1
 
-echo "== stage 2/14: ruff + mypy (skipped when not installed) =="
+echo "== stage 2/16: ruff + mypy (skipped when not installed) =="
 # Configured in pyproject.toml; the container does not bake these in, so the
 # stage gates on availability instead of failing the whole run.
 if command -v ruff >/dev/null 2>&1; then
@@ -33,16 +34,16 @@ else
   echo "mypy not installed; skipping"
 fi
 
-echo "== stage 3/14: telemetry schema lint =="
+echo "== stage 3/16: telemetry schema lint =="
 python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
 
-echo "== stage 4/14: CPU prefetch smoke (depth 2 ≡ depth 0) =="
+echo "== stage 4/16: CPU prefetch smoke (depth 2 ≡ depth 0) =="
 # Two-task synthetic run on the per-batch step path at --prefetch_depth 2;
 # its accuracy matrix must match a depth-0 run exactly (the asynchronous
 # input pipeline's determinism guarantee, data/prefetch.py).
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/prefetch_smoke.py || exit 1
 
-echo "== stage 5/14: jaxlint self-test fixtures =="
+echo "== stage 5/16: jaxlint self-test fixtures =="
 # The linter must still *find* the hazards it exists for (incl. the PR 3
 # restore-aliasing regression); covered by tests/test_jaxlint.py in tier-1,
 # but a broken linter that silently passes everything would also pass stage 1,
@@ -158,7 +159,7 @@ with tempfile.TemporaryDirectory() as d:
 print("fleetlint flags all five SPMD hazards at the expected lines: OK")
 PY
 
-echo "== stage 6/14: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
+echo "== stage 6/16: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # A tiny synthetic run SIGKILLs itself mid-task (--fault_spec kill@task1.epoch2),
 # scripts/supervise.py relaunches it with --resume, and the completed run's
 # accuracy matrix must be bit-identical to its fault-free twin — the
@@ -168,7 +169,18 @@ echo "== stage 6/14: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # thread_violation records (analysis/threadcheck.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 
-echo "== stage 7/14: CPU lockstep chaos (2-process seeded divergence) =="
+echo "== stage 7/16: CPU warm-cache smoke (trace-free supervised resume + serving AOT load) =="
+# The --compile_cache acceptance proof: the chaos protocol re-run against a
+# run-local persistent XLA cache that starts EMPTY.  The first child compiles
+# cold (populating the cache through the supervisor's env passthrough), kills
+# itself, and the relaunch must resume with compile_s ~= 0 (compile_event
+# telemetry via jax.monitoring) while holding its --recompile_budget; the
+# exported artifact is then AOT-loaded twice and the second load must be
+# served from the cache with an identical trace count
+# (scripts/warmcache_smoke.py, telemetry/compilewatch.py).
+timeout -k 10 3200 env JAX_PLATFORMS=cpu python scripts/warmcache_smoke.py || exit 1
+
+echo "== stage 8/16: CPU lockstep chaos (2-process seeded divergence) =="
 # A real 2-process jax.distributed CPU cluster under --check_lockstep
 # (analysis/lockstep.py): the clean run must fingerprint every dispatch on
 # both processes with zero violations, and a seeded single-process batch
@@ -180,7 +192,7 @@ timeout -k 10 3400 env JAX_PLATFORMS=cpu python -m pytest \
   "tests/test_multihost.py::test_lockstep_sentinel_catches_seeded_divergence" \
   -q -p no:cacheprovider -p no:xdist -p no:randomly -m '' || exit 1
 
-echo "== stage 8/14: CPU serve smoke (export + hot-swap under fire) =="
+echo "== stage 9/16: CPU serve smoke (export + hot-swap under fire) =="
 # Train a tiny 2-task run with --export_dir, then serve the artifacts under
 # live traffic while hot-swapping task 0 -> 1 with an injected swap_ioerror:
 # the failed swap must degrade gracefully (keep serving task 0, emit
@@ -191,18 +203,26 @@ echo "== stage 8/14: CPU serve smoke (export + hot-swap under fire) =="
 # ThreadCheck sentinel and must emit zero thread_violation records.
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || exit 1
 
-echo "== stage 9/14: perf regression gate (bench.py vs BASELINE.json) =="
+echo "== stage 10/16: perf regression gate (bench.py vs BASELINE.json) =="
 # step_ms is hard-gated at +15% vs the committed bench_gate entry;
 # fetch_overhead_ms loosely (see scripts/perf_gate.py).  After a deliberate
 # perf change, refresh with: python scripts/perf_gate.py --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py || exit 1
 
-echo "== stage 10/14: serving perf gate (bench.py --serve vs BASELINE.json) =="
+echo "== stage 11/16: compile gate (bench.py cold/warm vs BASELINE.json) =="
+# Warm-cache net XLA compile time (backend compile minus persistent-cache
+# retrieval, jax.monitoring) measured by running bench.py twice against one
+# fresh cache dir; the warm run is hard-gated vs the compile_gate entry and
+# self-relatively vs its own cold run.  Refresh:
+# python scripts/perf_gate.py --compile --update-baseline
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --compile || exit 1
+
+echo "== stage 12/16: serving perf gate (bench.py --serve vs BASELINE.json) =="
 # Closed-loop p99 latency of the micro-batching server, gated at +15% vs
 # the serve_gate entry.  Refresh: python scripts/perf_gate.py --serve --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve || exit 1
 
-echo "== stage 11/14: fleet overload soak (replicas + SIGKILL + rolling swap) =="
+echo "== stage 13/16: fleet overload soak (replicas + SIGKILL + rolling swap) =="
 # The resilience-tier chaos smoke: three supervised replica subprocesses
 # behind the admission-controlled front end under live bursty two-priority
 # traffic.  One replica is SIGKILL'd mid-traffic (breaker eject -> supervised
@@ -213,21 +233,21 @@ echo "== stage 11/14: fleet overload soak (replicas + SIGKILL + rolling swap) ==
 # (serving/frontend.py, serving/replica.py, serving/health.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py --fleet || exit 1
 
-echo "== stage 12/14: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
+echo "== stage 14/16: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
 # High-priority p99 under bursty overload through the replicated front end,
 # gated at +15% vs the serve_overload_gate entry: shedding low-priority work
 # exists precisely to keep this number flat.  Refresh:
 # python scripts/perf_gate.py --serve-overload --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve-overload || exit 1
 
-echo "== stage 13/14: metrics overhead gate (bench.py --metrics paired) =="
+echo "== stage 15/16: metrics overhead gate (bench.py --metrics paired) =="
 # Registry-on vs registry-off cost of the hot-path instruments, measured
 # over the identical compiled step in one process (alternating passes,
 # min-of-passes).  Hard-gated at 3%: the metrics plane must stay
 # effectively free or it gets switched off in production runs.
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --metrics-overhead || exit 1
 
-echo "== stage 14/14: tier-1 tests =="
+echo "== stage 16/16: tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

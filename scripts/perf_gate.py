@@ -5,6 +5,7 @@ Usage: python scripts/perf_gate.py                  # gate (ci.sh stage)
        python scripts/perf_gate.py --update-baseline  # (re)record the entry
        python scripts/perf_gate.py --result '<json>'  # gate a canned result
        python scripts/perf_gate.py --serve             # serving-latency gate
+       python scripts/perf_gate.py --compile           # warm-cache compile gate
 
 Runs ``bench.py`` (the CPU reduced fallback under ``JAX_PLATFORMS=cpu``:
 batch 64, 5 iters — ~30 s with a warm compile cache), parses its single JSON
@@ -51,6 +52,13 @@ the burst — a regression here means the shed policy, breaker, or hedging
 changed behaviour.  Any hard request error fails outright; sheds are the
 mechanism under test, not a failure.
 
+``--compile`` gates the trace-free-restart promise: bench.py runs twice
+against one fresh persistent compilation cache dir, and the second (warm)
+run's net XLA compile time (``xla_compile_s``, jax.monitoring backend time
+minus cache-retrieval time) is gated against the ``compile_gate`` baseline
+entry plus an absolute slack, and self-relatively against the cold run — a
+cache that silently stopped serving fails even when the baseline is stale.
+
 Exit 0 on pass/skip, 1 on fail, one JSON verdict line either way.
 """
 
@@ -78,11 +86,21 @@ METRICS_OVERHEAD_MAX = 0.03
 FETCH_FACTOR = 3.0   # loose multiplicative gate for fetch_overhead_ms
 FETCH_SLACK_MS = 5.0  # absolute slack on top of the factor
 FETCH_ARM_MS = 1.0   # the fetch gate arms only at a meaningful baseline
+# Warm-cache compile gate (--compile): the warm run's net XLA compile time
+# (bench.py xla_compile_s — backend compile minus persistent-cache
+# retrieval) must stay near zero.  The tolerance is generous (compile
+# timing is noisier than step timing) plus an absolute slack; the
+# self-relative check (warm vs the cold run measured in the same
+# invocation) catches a cache that silently stopped serving even when the
+# baseline entry is missing or stale.
+COMPILE_TOLERANCE = 0.5
+COMPILE_SLACK_S = 2.0
+COMPILE_WARM_FRAC = 0.2  # warm must be < this fraction of cold
 
 
-def run_bench(timeout_s: float = 600.0, extra_args=()) -> dict:
+def run_bench(timeout_s: float = 600.0, extra_args=(), env_extra=None) -> dict:
     """Run bench.py on CPU and parse the last JSON line of its stdout."""
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py"), *extra_args],
         cwd=_REPO, env=env, capture_output=True, text=True,
@@ -144,6 +162,88 @@ def gate(result: dict, baseline: dict) -> dict:
             f"note: step_ms improved {base_step:.1f} -> {step:.1f}; "
             "refresh the baseline to tighten the gate")
         return {"status": "pass", "reasons": reasons}
+    return {"status": "fail" if reasons else "pass", "reasons": reasons}
+
+
+def run_compile_pair(timeout_s: float = 900.0) -> dict:
+    """Cold/warm compile measurement: bench.py twice against one fresh
+    persistent-cache dir (``CIL_BENCH_CACHE_DIR``).  The first run pays the
+    real XLA backend compile and populates the cache; the second must be
+    served from it — its ``xla_compile_s`` is the number the compile gate
+    hard-gates (trace-free restarts are the whole point of
+    ``--compile_cache``)."""
+    import shutil
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="cil_compile_gate_")
+    extra = ("--iters", "2", "--fused_n", "0", "--no_bf16")
+    env = {"CIL_BENCH_CACHE_DIR": cache}
+    try:
+        cold = run_bench(timeout_s=timeout_s, extra_args=extra, env_extra=env)
+        warm = run_bench(timeout_s=timeout_s, extra_args=extra, env_extra=env)
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    result = {
+        "metric": "compile_gate",
+        "value": warm.get("xla_compile_s", 0.0),
+        "unit": "s",
+        "cold_compile_s": cold.get("xla_compile_s"),
+        "warm_compile_s": warm.get("xla_compile_s"),
+        "warm_cache_hits": warm.get("xla_cache_hits"),
+        "backend": warm.get("backend"),
+        "global_batch": warm.get("global_batch"),
+    }
+    for side, r in (("cold", cold), ("warm", warm)):
+        if r.get("error"):
+            result["error"] = f"{side} bench failed: {r['error']}"
+    return result
+
+
+def gate_compile(result: dict, baseline: dict) -> dict:
+    """Compile gate: warm-cache net XLA compile time vs ``compile_gate``.
+
+    Two independent checks (either trips the gate):
+
+    * absolute/baseline — ``warm_compile_s`` above baseline × (1 + tol)
+      + ``COMPILE_SLACK_S``; with no baseline entry the limit is the slack
+      alone, so a cache that stopped serving fails even pre-baseline.
+    * self-relative — warm above ``COMPILE_WARM_FRAC`` × cold (when the
+      cold side measured a nontrivial compile): the warm run re-compiled a
+      meaningful share of what the cold run built.
+    """
+    if result.get("error"):
+        return {"status": "fail",
+                "reasons": [f"compile bench did not produce a valid "
+                            f"measurement: {result['error']}"]}
+    warm = result.get("warm_compile_s")
+    cold = result.get("cold_compile_s")
+    if warm is None or cold is None:
+        return {"status": "fail",
+                "reasons": ["no cold/warm xla_compile_s in the bench result "
+                            "(bench.py too old?)"]}
+    for key in ("backend", "global_batch"):
+        if baseline.get(key) is not None and result.get(key) != baseline[key]:
+            return {"status": "skip",
+                    "reasons": [f"incomparable {key}: baseline "
+                                f"{baseline[key]!r} vs measured "
+                                f"{result.get(key)!r} — refresh the baseline "
+                                "on this machine (--compile "
+                                "--update-baseline)"]}
+    reasons = []
+    tol = baseline.get("tolerance", COMPILE_TOLERANCE)
+    base_warm = baseline.get("warm_compile_s")
+    limit = (base_warm * (1.0 + tol) if base_warm is not None else 0.0
+             ) + COMPILE_SLACK_S
+    if warm > limit:
+        reasons.append(
+            f"warm-cache compile_s regressed: {warm:.2f} > {limit:.2f} "
+            f"(baseline {base_warm if base_warm is not None else 0:.2f} "
+            f"+ {tol:.0%} + {COMPILE_SLACK_S:g}s slack)")
+    if cold > COMPILE_SLACK_S and warm > cold * COMPILE_WARM_FRAC:
+        reasons.append(
+            f"persistent cache not serving: warm compile_s {warm:.2f} > "
+            f"{COMPILE_WARM_FRAC:.0%} of cold {cold:.2f} — the second run "
+            "re-compiled what the first just cached")
     return {"status": "fail" if reasons else "pass", "reasons": reasons}
 
 
@@ -296,9 +396,20 @@ def load_baseline(path: str = _BASELINE) -> dict:
 
 
 def update_baseline(result: dict, path: str = _BASELINE,
-                    serve: bool = False, overload: bool = False) -> dict:
+                    serve: bool = False, overload: bool = False,
+                    compile_: bool = False) -> dict:
     doc = load_baseline(path)
-    if overload:
+    if compile_:
+        entry = {
+            "warm_compile_s": result.get("warm_compile_s"),
+            "cold_compile_s": result.get("cold_compile_s"),
+            "backend": result.get("backend"),
+            "global_batch": result.get("global_batch"),
+            "tolerance": COMPILE_TOLERANCE,
+            "recorded_ts": round(time.time(), 3),
+        }
+        doc["compile_gate"] = entry
+    elif overload:
         entry = {
             "p99_high_ms": result.get("p99_high_ms"),
             "hist_p99_high_ms": result.get("hist_p99_high_ms"),
@@ -354,6 +465,11 @@ def main(argv=None) -> int:
     p.add_argument("--serve-overload", action="store_true",
                    help="gate the fleet overload bench (bench.py --serve "
                    "--serve_pattern bursty) against serve_overload_gate")
+    p.add_argument("--compile", action="store_true", dest="compile_",
+                   help="gate the warm-cache compile cost (bench.py twice "
+                   "against one fresh persistent cache dir) against the "
+                   "compile_gate entry — trace-free restarts must stay "
+                   "trace-free")
     p.add_argument("--metrics-overhead", action="store_true",
                    help="gate the metrics-plane cost (bench.py --metrics "
                    "paired) against the fixed 3%% registry-on vs "
@@ -365,7 +481,10 @@ def main(argv=None) -> int:
                    help="path to BASELINE.json")
     args = p.parse_args(argv)
 
-    if args.metrics_overhead:
+    if args.compile_:
+        extra = ()
+        entry_key = "compile_gate"
+    elif args.metrics_overhead:
         extra = ("--metrics", "paired",
                  "--step_path_epochs", "1", "--step_path_steps", "4")
         entry_key = "metrics_overhead_gate"
@@ -381,6 +500,7 @@ def main(argv=None) -> int:
         extra = ()
         entry_key = "bench_gate"
     result = (json.loads(args.result) if args.result
+              else run_compile_pair() if args.compile_
               else run_bench(extra_args=extra))
     if args.metrics_overhead:
         # Self-relative gate: no baseline entry, no --update-baseline.
@@ -398,12 +518,17 @@ def main(argv=None) -> int:
         return 1 if verdict["status"] == "fail" else 0
     if args.update_baseline:
         entry = update_baseline(result, args.baseline, serve=args.serve,
-                                overload=args.serve_overload)
+                                overload=args.serve_overload,
+                                compile_=args.compile_)
         print(json.dumps({"metric": "perf_gate", "status": "updated",
                           entry_key: entry}))
         return 0 if not result.get("error") else 1
     baseline = load_baseline(args.baseline).get(entry_key, {})
-    if args.serve_overload:
+    if args.compile_:
+        verdict = gate_compile(result, baseline)
+        measured_keys = ("warm_compile_s", "cold_compile_s",
+                         "warm_cache_hits", "backend", "global_batch")
+    elif args.serve_overload:
         verdict = gate_serve_overload(result, baseline)
         measured_keys = ("p99_high_ms", "hist_p99_high_ms", "value",
                          "errors", "backend", "replicas", "pattern", "rps",

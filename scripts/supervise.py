@@ -123,6 +123,13 @@ def _parse_args(argv: List[str]):
                    help="comma-separated heartbeat digest fields watched "
                    "by --stall_age; a process whose beat carries none of "
                    "them is never stall-killed")
+    p.add_argument("--compile_cache", default=None,
+                   help="persistent XLA compile-cache directory exported to "
+                   "every (re)launch as JAX_COMPILATION_CACHE_DIR, so a "
+                   "resumed child re-fetches its executables instead of "
+                   "re-tracing+re-compiling them (trace-free restarts); "
+                   "the supervisor itself never imports jax — env is the "
+                   "only mechanism that survives the process boundary")
     p.add_argument("--metrics_agent", default=None,
                    help="argument string for scripts/metrics_agent.py, run "
                    "as a sidecar for the supervised run's lifetime "
@@ -240,12 +247,29 @@ class Supervisor:
             except subprocess.TimeoutExpired:
                 continue
 
+    def _child_env(self) -> Optional[dict]:
+        """Child environment.  ``--compile_cache`` rides the env (jax config
+        options read their uppercase env names at import), so *every*
+        relaunch — not just ones whose command line carries a flag — lands
+        on the same persistent XLA cache and resumes trace-free.  The
+        thresholds are zeroed so even the small CIL-sized programs persist;
+        explicit settings already in the environment win."""
+        if not self.args.compile_cache:
+            return None  # inherit untouched
+        env = dict(os.environ)
+        cache_dir = os.path.abspath(self.args.compile_cache)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+        return env
+
     def _run_once(self, cmd: List[str]):
         """Launch and babysit one child; returns (returncode, uptime_s,
         hung)."""
         start = time.monotonic()
         self._progress.clear()  # a fresh child restarts its counters
-        proc = subprocess.Popen(cmd, start_new_session=True)
+        proc = subprocess.Popen(cmd, start_new_session=True,
+                                env=self._child_env())
         self._event("launch", pid=proc.pid, cmd=cmd)
         hung = False
         while True:

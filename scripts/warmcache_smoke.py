@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""CPU warm-cache smoke: kill, supervised resume, prove the restart was
+trace-free where it counts — the relaunch fetched its executables from the
+persistent XLA compilation cache instead of re-compiling them.
+
+The acceptance proof for ``--compile_cache`` end to end, with real
+processes and a run-local cache directory that starts EMPTY (so cold vs
+warm is measured, not assumed):
+
+1. Run the tiny 2-task synthetic protocol under ``scripts/supervise.py
+   --compile_cache <fresh dir>`` with ``--fault_spec kill@task1.epoch2``:
+   the first child compiles everything cold (populating the cache via the
+   supervisor's ``JAX_COMPILATION_CACHE_DIR`` env passthrough), SIGKILLs
+   itself, and the relaunch resumes from the epoch checkpoint.
+2. Assert from the run's ``compile_event`` telemetry (CompileWatch:
+   net XLA work = backend compile time − persistent-cache retrieval time)
+   that the cold events measured real compilation and the resumed event's
+   ``compile_s`` is ≈0 — relative (< ``WARM_FRAC`` of cold) when the cold
+   side is nontrivial, absolute (< ``WARM_SLACK_S``) always.
+3. Assert the run held its ``--recompile_budget``: every
+   ``recompile_budget`` record has ``ok=true`` (the traces that did happen
+   were within the task-growth/restore budget — re-*tracing* is expected
+   on relaunch; re-*compiling* is what the cache eliminates).
+4. Serving twin of the same proof: AOT-load the artifact the run exported
+   twice against one fresh serving cache — the second load's net compile
+   must collapse the same way, with an identical trace count.
+
+Exit 0 on pass, 1 otherwise, one JSON line either way.
+Used by ``scripts/ci.sh``; runnable standalone from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+WARM_FRAC = 0.2    # resumed compile_s must be under this fraction of cold
+WARM_SLACK_S = 5.0  # ... and under this absolutely (sub-threshold programs
+#                     below the persistence cutoff legitimately recompile)
+COLD_FLOOR_S = 2.0  # the relative check arms only when cold was nontrivial
+
+# Same shapes as chaos_smoke (2 tasks x 3 epochs, resnet20, batch 16) but
+# WITHOUT the shared tests/.jax_cache: this smoke's entire point is a cache
+# whose cold/warm state it controls.
+_PROTO = [
+    "--platform", "cpu",
+    "--data_set", "synthetic10",
+    "--num_bases", "0",
+    "--increment", "5",
+    "--backbone", "resnet20",
+    "--batch_size", "16",
+    "--num_epochs", "3",
+    "--eval_every_epoch", "100",
+    "--memory_size", "40",
+    "--lr", "0.05",
+    "--aa", "none",
+    "--color_jitter", "0.0",
+    "--seed", "7",
+    "--no_fused_epochs",
+]
+
+# Serving AOT loader, run as a subprocess twice against one cache dir.  The
+# child prints one JSON line: net XLA compile work + trace count of the load.
+_SERVE_LOADER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["SMOKE_REPO"])
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (
+    force_platform,
+)
+force_platform("cpu")
+import jax
+jax.config.update("jax_compilation_cache_dir", os.environ["SMOKE_SERVE_CACHE"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+try:
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+except AttributeError:
+    pass
+import numpy as np
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (
+    CompileWatch,
+)
+from serving.server import InferenceServer
+watch = CompileWatch.install()
+before = watch.snapshot()
+srv = InferenceServer(os.environ["SMOKE_EXPORT_DIR"], auto_swap=False).start()
+meta = srv._artifact.meta
+x = np.zeros((meta["input_size"], meta["input_size"], meta["channels"]),
+             np.uint8)
+srv.submit(x).result(timeout=300.0)
+delta = CompileWatch.delta(before, watch.snapshot())
+traces = srv.trace_count()
+srv.stop()
+print(json.dumps({**delta, "traces": traces}))
+"""
+
+
+def _records(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _serve_load(export_dir: str, cache_dir: str, timeout: float):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SMOKE_REPO=_REPO,
+        SMOKE_EXPORT_DIR=export_dir,
+        SMOKE_SERVE_CACHE=cache_dir,
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _SERVE_LOADER],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {"error": f"serve loader rc={proc.returncode}: "
+                     f"{proc.stderr.strip()[-400:]}"}
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="warmcache_smoke_") as tmp:
+        cache = os.path.join(tmp, "xla_cache")
+        serve_cache = os.path.join(tmp, "serve_cache")
+        tdir = os.path.join(tmp, "tel")
+        run_log = os.path.join(tdir, "run.jsonl")
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        export_dir = os.path.join(tmp, "export")
+
+        cmd = [
+            sys.executable, os.path.join(_REPO, "scripts", "supervise.py"),
+            "--backoff_base", "0.1", "--backoff_max", "1",
+            "--max_failures", "3", "--failure_window", "300",
+            "--telemetry_dir", tdir,
+            "--fault_ledger", os.path.join(ckpt_dir, "fault_ledger.jsonl"),
+            "--compile_cache", cache,
+            "--",
+            sys.executable, os.path.join(_REPO, "train.py"), *_PROTO,
+            "--telemetry_dir", tdir,
+            "--ckpt_dir", ckpt_dir,
+            "--export_dir", export_dir,
+            "--epoch_ckpt_every", "1",
+            "--fault_spec", "kill@task1.epoch2",
+            "--recompile_budget",
+        ]
+        run = subprocess.run(cmd, cwd=_REPO, timeout=3000)
+
+        failures = []
+        if run.returncode != 0:
+            failures.append(f"supervisor exited rc={run.returncode}")
+        recs = _records(run_log) if os.path.exists(run_log) else []
+
+        if not any(r.get("type") == "fault_injected" for r in recs):
+            failures.append("kill fault did not fire")
+        if not any(r.get("type") == "resume" for r in recs):
+            failures.append("relaunch did not resume from a checkpoint")
+        if not any(r.get("type") == "final" for r in recs):
+            failures.append("run produced no final record")
+
+        events = [r for r in recs if r.get("type") == "compile_event"]
+        cold = [e for e in events if not e.get("resumed")]
+        warm = [e for e in events if e.get("resumed")]
+        cold_s = round(sum(e.get("compile_s", 0.0) for e in cold), 3)
+        warm_s = round(sum(e.get("compile_s", 0.0) for e in warm), 3)
+        warm_hits = sum(e.get("cache_hits", 0) for e in warm)
+        if not cold:
+            failures.append("no cold compile_event records")
+        if not warm:
+            failures.append("no resumed compile_event record — the relaunch "
+                            "never reached its first epoch window")
+        if warm:
+            if warm_s > WARM_SLACK_S:
+                failures.append(
+                    f"resumed compile_s {warm_s} > {WARM_SLACK_S}s — the "
+                    "relaunch re-compiled instead of fetching from the cache")
+            if cold_s >= COLD_FLOOR_S and warm_s > cold_s * WARM_FRAC:
+                failures.append(
+                    f"resumed compile_s {warm_s} > {WARM_FRAC:.0%} of cold "
+                    f"{cold_s} — warm restart is not trace-free")
+            if warm_hits == 0:
+                failures.append("resumed window saw zero persistent-cache "
+                                "hits — the cache was not consulted")
+
+        budget = [r for r in recs if r.get("type") == "recompile_budget"]
+        bad_budget = [r for r in budget if not r.get("ok")]
+        if not budget:
+            failures.append("no recompile_budget records under "
+                            "--recompile_budget")
+        if bad_budget:
+            failures.append(f"{len(bad_budget)} recompile_budget violation(s):"
+                            f" {bad_budget[:2]}")
+
+        # Serving twin: cold AOT load populates the serve cache, the second
+        # load must be served from it with the identical trace count.
+        serve_cold = serve_warm = None
+        if os.path.isdir(export_dir):
+            serve_cold = _serve_load(export_dir, serve_cache, timeout=1200)
+            serve_warm = _serve_load(export_dir, serve_cache, timeout=1200)
+            for side, res in (("cold", serve_cold), ("warm", serve_warm)):
+                if res.get("error"):
+                    failures.append(f"serving {side} load failed: "
+                                    f"{res['error']}")
+            if not failures or (serve_cold.get("error") is None
+                                and serve_warm.get("error") is None):
+                sc = serve_cold.get("compile_s", 0.0)
+                sw = serve_warm.get("compile_s", 0.0)
+                if sw > WARM_SLACK_S:
+                    failures.append(f"warm serving load compile_s {sw} > "
+                                    f"{WARM_SLACK_S}s")
+                if sc >= COLD_FLOOR_S and sw > sc * WARM_FRAC:
+                    failures.append(
+                        f"warm serving load compile_s {sw} > "
+                        f"{WARM_FRAC:.0%} of cold {sc}")
+                if serve_warm.get("cache_hits", 0) == 0:
+                    failures.append("warm serving load saw zero "
+                                    "persistent-cache hits")
+                if serve_cold.get("traces") != serve_warm.get("traces"):
+                    failures.append(
+                        f"serving trace counts differ cold vs warm: "
+                        f"{serve_cold.get('traces')} vs "
+                        f"{serve_warm.get('traces')}")
+        else:
+            failures.append("training run exported no serving artifact")
+
+        print(json.dumps({
+            "metric": "warmcache_smoke",
+            "ok": not failures,
+            "failures": failures,
+            "cold_compile_s": cold_s,
+            "resumed_compile_s": warm_s,
+            "resumed_cache_hits": warm_hits,
+            "serve_cold": serve_cold,
+            "serve_warm": serve_warm,
+        }))
+        return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -301,10 +301,15 @@ def export_from_trainer(trainer, task_id: int, known_after: int,
     cfg = trainer.config
     params = trainer.state.params
     fc_bias = np.asarray(jax.device_get(params["fc_bias"]))
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.ops.precision import (
+        policy_from_config,
+    )
+
     model_meta = {
         "backbone": cfg.backbone,
         "width": int(fc_bias.shape[0]),
         "compute_dtype": cfg.compute_dtype,
+        "precision": policy_from_config(cfg).name,
         "bn_group_size": int(cfg.bn_group_size),
     }
     return export_artifact(
@@ -465,17 +470,24 @@ def rebuild_model(meta: dict):
     from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
         create_model,
     )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.ops.precision import (
+        get_policy,
+    )
 
     mm = meta["model"]
-    dtype = jnp.bfloat16 if mm.get("compute_dtype") == "bfloat16" else jnp.float32
+    # New artifacts carry the policy name; pre-policy artifacts only have
+    # compute_dtype, which get_policy accepts as an alias.
+    policy = get_policy(
+        mm.get("precision") or mm.get("compute_dtype", "float32")
+    )
     model, _ = create_model(
         mm["backbone"],
         mm["width"],
-        dtype=dtype,
         width_multiple=1,
         input_size=meta["input_size"],
         channels=meta["channels"],
         bn_group_size=mm.get("bn_group_size", 0),
+        policy=policy,
     )
     aug_cfg = AugmentConfig(
         input_size=meta["input_size"],

@@ -256,6 +256,7 @@ def supervised_replica_cmd(
     backoff_max: float = 2.0,
     check_threads: bool = False,
     python: Optional[str] = None,
+    compile_cache: Optional[str] = None,
 ) -> list:
     """The ``scripts/supervise.py`` command line that runs one replica as a
     supervised subprocess — the same relaunch machinery training uses, so a
@@ -278,8 +279,16 @@ def supervised_replica_cmd(
                   "--fault_ledger", os.path.join(rdir, "fault_ledger.jsonl")]
     if check_threads:
         child.append("--check_threads")
+    if compile_cache:
+        # Both sides: the child flag arms the persistent cache for a direct
+        # launch, the supervisor flag exports JAX_COMPILATION_CACHE_DIR so a
+        # *relaunched* replica re-fetches its serving executables instead of
+        # re-compiling them (trace-free failover).
+        child += ["--compile_cache", compile_cache]
+    sup_extra = (["--compile_cache", compile_cache] if compile_cache else [])
     return [
         py, os.path.join(repo_root, "scripts", "supervise.py"),
+    ] + sup_extra + [
         "--heartbeat", os.path.join(rdir, "heartbeat.json"),
         "--max_age", str(max_age_s),
         "--poll", "0.5", "--grace", "20",
@@ -311,6 +320,11 @@ def main(argv=None) -> int:
     p.add_argument("--fault_spec", default=None)
     p.add_argument("--fault_ledger", default=None)
     p.add_argument("--check_threads", action="store_true")
+    p.add_argument("--compile_cache", default=None,
+                   help="persistent XLA compile-cache directory; a replica "
+                   "armed with the cache its trainer populated loads the "
+                   "serving executable without re-compiling (trace-free "
+                   "model swap / failover)")
     p.add_argument("--heartbeat_s", type=float, default=2.0)
     p.add_argument("--metrics_interval_s", type=float, default=2.0,
                    help="MetricsPump flush cadence for metrics_snapshot "
@@ -344,6 +358,21 @@ def main(argv=None) -> int:
         if check is not None:
             check.bind_sink(telemetry.sink)
 
+    if args.compile_cache:
+        from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (  # noqa: E501
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(args.compile_cache)
+    # Price the AOT load + warmup: with a warm persistent cache compile_s
+    # must be ≈0 (scripts/warmcache_smoke.py asserts it; perf_gate gates it).
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.telemetry import (  # noqa: E501
+        CompileWatch,
+    )
+
+    watch = CompileWatch.install()
+    watch_before = watch.snapshot()
+
     faults = None
     if args.fault_spec:
         from faults.injector import injector_from
@@ -363,12 +392,17 @@ def main(argv=None) -> int:
         sink=sink,
         faults=faults,
     ).start()
+    compile_delta = CompileWatch.delta(watch_before, watch.snapshot())
+    if sink is not None:
+        sink.log("compile_event", task_id=int(replica.server.task_id or 0),
+                 source="replica", **compile_delta)
     if telemetry is not None:
         telemetry.heartbeat.update(force=True, phase="serve",
                                    task=replica.server.task_id or 0)
         telemetry.heartbeat.start()
     print(f"| replica {args.replica_id} serving task "
-          f"{replica.server.task_id} on {replica.host}:{replica.port}",
+          f"{replica.server.task_id} on {replica.host}:{replica.port} "
+          f"(compile_s={compile_delta['compile_s']})",
           flush=True)
     try:
         while True:

@@ -152,6 +152,52 @@ def test_epoch_checkpoint_orbax_round_trip(devices8, tmp_path):
     assert not any("_epoch_" in n for n in os.listdir(ckpt))
 
 
+def test_bf16_selective_epoch_resume_bit_exact(devices8, tmp_path):
+    """Under ``--precision bf16_selective`` the checkpoint round trip keeps
+    every master copy float32 and bit-exact through an epoch-granular
+    crash/resume: the resumed run's params, SGD momentum and BN statistics
+    match the fault-free twin array-for-array, and nothing was narrowed to
+    bf16 on the way through save/restore (the JL104 contract, proved on the
+    real store rather than by lint)."""
+    from faults.injector import FaultInjected
+
+    mesh = make_mesh((8, 1))
+    ckpt = str(tmp_path / "ckpts")
+    kw = dict(precision="bf16_selective")
+    spec = "raise@task1.epoch1"
+
+    twin = CilTrainer(_cfg(**kw), mesh=mesh, init_dist=False)
+    ref = twin.fit()
+
+    crashed = CilTrainer(
+        _cfg(ckpt_dir=ckpt, epoch_ckpt_every=1, fault_spec=spec, **kw),
+        mesh=mesh,
+        init_dist=False,
+    )
+    with pytest.raises(FaultInjected):
+        crashed.fit()
+
+    resumed = CilTrainer(
+        _cfg(ckpt_dir=ckpt, epoch_ckpt_every=1, fault_spec=spec,
+             resume=True, **kw),
+        mesh=mesh,
+        init_dist=False,
+    )
+    assert resumed.start_task == 1
+    assert resumed.start_epoch == 1
+    out = resumed.fit()
+
+    assert out["acc1s"] == ref["acc1s"]
+    for tree_name in ("params", "momentum", "batch_stats"):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(twin.state, tree_name)),
+            jax.tree_util.tree_leaves(getattr(resumed.state, tree_name)),
+        ):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.dtype == np.float32  # master copies never narrowed
+            np.testing.assert_array_equal(a, b)
+
+
 def test_incomplete_orbax_checkpoint_ignored(tmp_path):
     """An orbax dir without its metadata sidecar is not a resumable
     checkpoint (crash window between the two writes), and a torn/corrupt

@@ -225,6 +225,34 @@ def test_bfloat16_end_to_end(devices8):
     assert leaf.dtype == jnp.float32
 
 
+@pytest.mark.heavy
+def test_bf16_selective_within_one_point_of_f32(two_task_result, devices8):
+    """The selective policy (bf16 conv/matmul compute, f32 master params /
+    momentum / BN stats / activations-between-ops) lands within one accuracy
+    point of the f32 reference run on the same 2-task protocol — the
+    headline claim of the precision layer (ops/precision.py), checked end to
+    end rather than per-op."""
+    _, ref = two_task_result
+    trainer = CilTrainer(
+        _smoke_config(precision="bf16_selective"),
+        mesh=make_mesh((8, 1)),
+        init_dist=False,
+    )
+    result = trainer.fit()
+    assert result["nb_tasks"] == 2
+    assert all(np.isfinite(a) for a in result["acc1s"])
+    gap = abs(
+        float(np.mean(result["acc1s"])) - float(np.mean(ref["acc1s"]))
+    )
+    assert gap <= 1.0, (result["acc1s"], ref["acc1s"])
+    # Master copies stay f32: params, SGD momentum, and BN statistics.
+    assert trainer.state.params["fc_kernel"].dtype == jnp.float32
+    for tree in (trainer.state.params, trainer.state.momentum,
+                 trainer.state.batch_stats):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            assert leaf.dtype == jnp.float32
+
+
 def test_image_folder_end_to_end(devices8, tmp_path):
     """The lazy image-folder dataset trains through the full loop at
     input_size > 32 (host RandomResizedCrop decode + on-device augment)."""

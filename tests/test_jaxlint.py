@@ -300,6 +300,83 @@ def test_jl103_suppression_comment(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# JL104 — f32 master state cast down to bf16
+# --------------------------------------------------------------------------- #
+
+
+def test_jl104_momentum_astype(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax.numpy as jnp
+
+        def shrink(state):
+            return state.momentum.astype(jnp.bfloat16)
+        """)
+    assert rules_of(findings) == ["JL104"]
+    (f,) = findings
+    assert "momentum" in f.message and "float32" in f.message
+
+
+def test_jl104_asarray_batch_stats(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax.numpy as jnp
+
+        def pack(batch_stats):
+            return jnp.asarray(batch_stats, jnp.bfloat16)
+        """)
+    assert rules_of(findings) == ["JL104"]
+
+
+def test_jl104_tree_map_lambda_on_opt_state(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def halve(opt_state):
+            return jax.tree_util.tree_map(
+                lambda t: t.astype(jnp.bfloat16), opt_state)
+        """)
+    assert rules_of(findings) == ["JL104"]
+
+
+def test_jl104_loss_convert_element_type(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax
+
+        def acc(loss_sum):
+            return jax.lax.convert_element_type(loss_sum, "bfloat16")
+        """)
+    assert rules_of(findings) == ["JL104"]
+
+
+def test_jl104_upcast_and_unguarded_names_are_clean(tmp_path):
+    # Upcasting master state to f32 is the contract; down-casting
+    # activations/params at the matmul boundary is exactly what selective
+    # precision prescribes — neither may flag.
+    findings = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def fine(state, x, params):
+            m = state.momentum.astype(jnp.float32)
+            y = x.astype(jnp.bfloat16)  # activation at the boundary
+            w = jax.tree_util.tree_map(
+                lambda t: t.astype(jnp.bfloat16), params)
+            return m, y, w
+        """)
+    assert findings == []
+
+
+def test_jl104_suppression_comment(tmp_path):
+    findings = run_lint(tmp_path, """
+        import jax.numpy as jnp
+
+        def export(batch_stats):
+            return jnp.asarray(batch_stats, jnp.bfloat16)  # jaxlint: disable=JL104 -- serialization only
+        """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
 # JL201 — host sync in hot loop
 # --------------------------------------------------------------------------- #
 
@@ -866,8 +943,9 @@ def test_cli_list_rules():
         capture_output=True, text=True,
     )
     assert proc.returncode == 0
-    for rule in ("JL001", "JL002", "JL101", "JL102", "JL103", "JL201",
-                 "JL301", "JL302", "JL303", "JL304", "JL305", "JL306"):
+    for rule in ("JL001", "JL002", "JL101", "JL102", "JL103", "JL104",
+                 "JL201", "JL301", "JL302", "JL303", "JL304", "JL305",
+                 "JL306"):
         assert rule in proc.stdout
 
 

@@ -1,0 +1,155 @@
+"""Precision policy layer (ops/precision.py): preset resolution, the
+--compute_dtype alias contract, config plumbing, the policy-compatible
+kernel registry, and the dtype seams the presets promise (masked head
+matmul accumulates f32; losses upcast at entry)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.config import CilConfig
+from a_pytorch_tutorial_to_class_incremental_learning_tpu.ops.precision import (
+    LOGITS_DTYPE,
+    PARAM_DTYPE,
+    PRESETS,
+    Policy,
+    get_policy,
+    kernel_policies,
+    kernel_policy_compatible,
+    policy_from_config,
+    register_policy_kernel,
+)
+
+
+def test_presets_honour_the_fixed_points():
+    assert set(PRESETS) == {"f32", "bf16_all", "bf16_selective"}
+    assert PARAM_DTYPE == jnp.float32 and LOGITS_DTYPE == jnp.float32
+    f32 = get_policy("f32")
+    assert (f32.compute_dtype, f32.act_dtype, f32.head_dtype) == (
+        jnp.float32, jnp.float32, jnp.float32)
+    sel = get_policy("bf16_selective")
+    assert sel.compute_dtype == jnp.bfloat16
+    assert sel.act_dtype == jnp.float32  # inter-op flow stays f32
+    assert sel.head_dtype == jnp.bfloat16
+    legacy = get_policy("bf16_all")
+    assert legacy.compute_dtype == jnp.bfloat16
+    assert legacy.act_dtype == jnp.bfloat16
+    assert legacy.head_dtype == jnp.float32  # head was never bf16 pre-policy
+
+
+def test_compute_dtype_aliases_resolve():
+    assert get_policy("float32") is PRESETS["f32"]
+    assert get_policy("bfloat16") is PRESETS["bf16_all"]
+    with pytest.raises(ValueError, match="unknown precision policy"):
+        get_policy("fp8")
+
+
+def test_policy_from_config_precedence():
+    # --precision wins over the legacy alias when both are set.
+    cfg = CilConfig(precision="bf16_selective", compute_dtype="float32")
+    assert policy_from_config(cfg).name == "bf16_selective"
+    # Legacy command lines keep working unchanged.
+    assert policy_from_config(CilConfig(compute_dtype="bfloat16")).name \
+        == "bf16_all"
+    assert policy_from_config(CilConfig()).name == "f32"
+
+
+def test_describe_is_json_friendly():
+    d = get_policy("bf16_selective").describe()
+    assert d == {
+        "name": "bf16_selective",
+        "compute_dtype": "bfloat16",
+        "act_dtype": "float32",
+        "head_dtype": "bfloat16",
+        "param_dtype": "float32",
+        "logits_dtype": "float32",
+    }
+
+
+def test_kernel_registry():
+    # The Pallas fused loss self-registers for every preset at import.
+    import a_pytorch_tutorial_to_class_incremental_learning_tpu.ops.fused_loss  # noqa: F401
+
+    assert kernel_policies("fused_masked_cross_entropy") == frozenset(
+        {"f32", "bf16_all", "bf16_selective"})
+    for name in PRESETS:
+        assert kernel_policy_compatible(
+            "fused_masked_cross_entropy", get_policy(name))
+    assert kernel_policies("no_such_kernel") == frozenset()
+    assert not kernel_policy_compatible("no_such_kernel", get_policy("f32"))
+    with pytest.raises(ValueError, match="unknown policy"):
+        register_policy_kernel("bad", "fp8")
+
+
+def test_masked_head_accumulates_f32_under_bf16_operands():
+    """The head matmul under bf16_selective: operands cast to bf16, logits
+    accumulated and returned f32 (preferred_element_type), masked columns
+    still NEG_INF."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models.classifier import (
+        NEG_INF,
+        masked_logits,
+    )
+
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+    fc = {
+        "kernel": jnp.asarray(rng.randn(16, 10).astype(np.float32) * 0.1),
+        "bias": jnp.zeros((10,), jnp.float32),
+    }
+    ref = masked_logits(feats, fc, jnp.int32(6))
+    got = masked_logits(feats, fc, jnp.int32(6), head_dtype=jnp.bfloat16)
+    assert ref.dtype == jnp.float32 and got.dtype == jnp.float32
+    assert np.all(np.asarray(got)[:, 6:] == NEG_INF)
+    # bf16 operands round the product but the result stays close to f32.
+    np.testing.assert_allclose(
+        np.asarray(got)[:, :6], np.asarray(ref)[:, :6], rtol=0.05, atol=0.05)
+
+
+def test_losses_upcast_bf16_logits_at_entry():
+    """CE/KD accumulate in f32 even when handed bf16 logits — feeding the
+    same values as bf16 vs f32 must agree to much better than bf16 epsilon
+    (the LOSS_DTYPE contract at the losses' entry seam)."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.engine.losses import (
+        cross_entropy,
+        soft_target_kd,
+    )
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models.classifier import (
+        NEG_INF,
+    )
+
+    rng = np.random.RandomState(1)
+    logits32 = rng.randn(8, 10).astype(np.float32)
+    logits32[:, 6:] = NEG_INF
+    labels = jnp.asarray(rng.randint(0, 6, 8))
+    lo16 = jnp.asarray(logits32).astype(jnp.bfloat16)
+    # bf16 -> f32 -> bf16 is lossless for values already rounded to bf16, so
+    # compare the bf16 input against its own f32 widening: any difference
+    # would come from accumulating in bf16.
+    wide = lo16.astype(jnp.float32)
+    ce16 = cross_entropy(lo16, labels, jnp.int32(6), 0.1)
+    ce32 = cross_entropy(wide, labels, jnp.int32(6), 0.1)
+    assert ce16.dtype == jnp.float32
+    assert np.isclose(float(ce16), float(ce32), rtol=1e-6)
+    t_wide = jnp.asarray(rng.randn(8, 10).astype(np.float32))
+    kd16 = soft_target_kd(lo16, t_wide, jnp.int32(6), temperature=2.0)
+    kd32 = soft_target_kd(wide, t_wide, jnp.int32(6), temperature=2.0)
+    assert kd16.dtype == jnp.float32
+    assert np.isclose(float(kd16), float(kd32), rtol=1e-6)
+
+
+def test_model_threads_policy_dtypes(devices8):
+    """create_model(policy=...) lands the policy's three dtypes on the
+    CilModel fields; the default stays the f32 reference."""
+    from a_pytorch_tutorial_to_class_incremental_learning_tpu.models import (
+        create_model,
+    )
+
+    sel, _ = create_model(
+        "resnet20", nb_classes=10, policy=get_policy("bf16_selective"))
+    assert sel.dtype == jnp.bfloat16
+    assert sel.act_dtype == jnp.float32
+    assert sel.head_dtype == jnp.bfloat16
+    ref, _ = create_model("resnet20", nb_classes=10)
+    assert ref.dtype == jnp.float32
+    assert ref.act_dtype is None and ref.head_dtype is None  # legacy path
