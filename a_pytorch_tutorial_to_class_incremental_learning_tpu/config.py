@@ -176,6 +176,11 @@ class CilConfig:
     # threading.Lock/RLock to detect lock-order inversions and lock-held
     # blocking calls at runtime; each emits a thread_violation record
     # (analysis/threadcheck.py; the chaos/serve smokes fail on any)
+    check_contracts: bool = False  # ContractSentinel: validate every live
+    # record type/field and metric instrument name against the committed
+    # contract registry (analysis/contract_registry.json) at emit time;
+    # each drift emits a contract_violation record
+    # (analysis/contractcheck.py; the chaos/serve smokes fail on any)
     check_lockstep: bool = False  # LockstepSentinel: fingerprint every
     # train/eval program dispatch (program + arg shapes + batch digest + RNG
     # coords), exchange fingerprints across the fleet, and fail with a named
@@ -356,6 +361,12 @@ def get_args_parser() -> argparse.ArgumentParser:
                    "held-lock sets and global acquisition order, emit a "
                    "thread_violation record on any lock-order inversion or "
                    "lock-held blocking call (analysis/threadcheck.py)")
+    p.add_argument("--check_contracts", action="store_true", default=False,
+                   help="install the ContractSentinel: validate every live "
+                   "record type/field and metric name against the committed "
+                   "contract registry, emit a contract_violation record on "
+                   "any drift the static contractlint pass could not see "
+                   "(analysis/contractcheck.py)")
     p.add_argument("--check_lockstep", action="store_true", default=False,
                    help="install the LockstepSentinel: fingerprint every "
                    "train/eval dispatch (program + arg shapes + batch digest "
@@ -515,6 +526,7 @@ def config_from_args(args: argparse.Namespace) -> CilConfig:
         recompile_budget=args.recompile_budget,
         check_donation=args.check_donation,
         check_threads=args.check_threads,
+        check_contracts=args.check_contracts,
         check_lockstep=args.check_lockstep,
         lockstep_dir=args.lockstep_dir,
         lockstep_deadline_s=args.lockstep_deadline_s,

@@ -101,11 +101,26 @@ class CilTrainer:
             from analysis import threadcheck
 
             self.threadcheck = threadcheck.install()
+        # Opt-in runtime contract (--check_contracts): validate every live
+        # record type/field and metric name against the committed contract
+        # registry — the dynamic complement of contractlint, catching names
+        # the AST pass can't see because they're built at runtime.
+        self.contractcheck = None
+        if config.check_contracts:
+            from analysis import contractcheck
+
+            self.contractcheck = contractcheck.install()
         log_path = config.log_file
         if log_path is None and config.telemetry_dir:
             log_path = os.path.join(config.telemetry_dir, "run.jsonl")
         # Resumed runs append so the pre-crash tasks' records survive.
         self.jsonl = JsonlLogger(log_path, append=config.resume)
+        if self.contractcheck is not None:
+            from analysis import contractcheck
+
+            # Wrapped *under* the Telemetry facade so the FlightSink tee's
+            # records are validated too.
+            self.jsonl = contractcheck.wrap_sink(self.jsonl)
         self.telemetry = Telemetry(
             telemetry_dir=config.telemetry_dir,
             heartbeat_path=config.heartbeat_path,
@@ -122,6 +137,12 @@ class CilTrainer:
         self.jsonl = self.telemetry.sink
         if self.threadcheck is not None:
             self.threadcheck.bind_sink(self.jsonl)
+        if self.contractcheck is not None:
+            from analysis import contractcheck
+
+            self.contractcheck.bind_sink(self.jsonl)
+            self.telemetry.metrics = contractcheck.wrap_registry(
+                self.telemetry.metrics)
         # Hot-path instruments resolved once here (with --no_metrics these
         # are shared no-ops), so the step loop pays one lock-protected add
         # per instrument and zero dict lookups.

@@ -100,7 +100,9 @@ class FlightRecorder:
             self._open_spans.append(entry)
             self._seq += 1
             self._events.append({
-                "type": "span_open",
+                # ring-internal forensic event, never written through the
+                # sink — not part of the schema vocabulary by design
+                "type": "span_open",  # jaxlint: disable=JL501
                 "ts": round(time.time(), 3),
                 **entry,
             })

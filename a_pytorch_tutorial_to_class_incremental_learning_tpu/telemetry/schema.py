@@ -1,0 +1,418 @@
+"""The telemetry record vocabulary: one table, three consumers.
+
+Single source of truth for every record type the unified sink can emit
+(``utils.logging.JsonlLogger`` via ``engine/loop.py`` and the telemetry
+package), plus the span file and the heartbeat file.  Consumed by:
+
+* ``scripts/check_telemetry_schema.py`` — the CI lint over committed
+  evidence logs (imports this table instead of carrying its own copy);
+* ``analysis/contracts.py`` — contractlint's JL501/JL502 cross-artifact
+  pass (emitted-type vs schema, consumer-field vs schema), which parses
+  this file's AST so the lint stage stays stdlib-only;
+* ``analysis/contractcheck.py`` — the ``--check_contracts`` runtime
+  sentinel validating live record types at emit time.
+
+Deliberately dependency-free and importable standalone (the lint scripts
+load it by file path with ``importlib`` so they never trigger the package
+``__init__`` — which would drag in jax).  Keep it that way: constants and
+pure functions only.
+"""
+
+from __future__ import annotations
+
+NUM = (int, float)
+
+# type -> (required {field: pytypes}, optional {field: pytypes}, extras)
+# extras: None = no undeclared fields allowed; "any" = any extra field;
+# "numeric" = extra fields allowed if numeric (the epoch record carries
+# whatever meters the train step emits).
+SCHEMA = {
+    "run": ({"data_set": str, "backbone": str, "seed": NUM}, {}, "any"),
+    "resume": (
+        {"start_task": NUM},
+        {"start_epoch": NUM, "path": str, "kind": str},
+        None,
+    ),
+    # Fault injection (faults/injector.py): one record per fired clause.
+    # reconciled=True marks a step-level clause settled at the fused-epoch
+    # boundary (reconcile_steps) rather than live at the per-batch site.
+    "fault_injected": (
+        {"site": str, "action": str, "spec": str},
+        {"task": NUM, "epoch": NUM, "step": NUM, "reconciled": bool},
+        None,
+    ),
+    # ThreadCheck sentinel (analysis/threadcheck.py, --check_threads): a
+    # lock-order inversion or lock-held blocking call observed at runtime.
+    # kind is lock_order_inversion (lock/other/witness set) or
+    # lock_held_blocking (call set); the chaos/serve smokes fail on any.
+    "thread_violation": (
+        {"kind": str, "thread": str, "site": str},
+        {"lock": str, "other": str, "witness": str, "call": str,
+         "held": list},
+        None,
+    ),
+    # ContractSentinel (analysis/contractcheck.py, --check_contracts): a
+    # live record type or metric instrument name that the committed
+    # contract registry (analysis/contract_registry.json) does not know —
+    # the dynamically-constructed drift the static JL501/JL505 pass cannot
+    # see.  kind is unknown_record_type / unknown_record_field /
+    # unknown_metric / metric_label_drift; the chaos/serve smokes fail on
+    # any.
+    "contract_violation": (
+        {"kind": str, "name": str},
+        {"field": str, "detail": str, "labels": list},
+        None,
+    ),
+    # Lockstep sentinel (analysis/lockstep.py, --check_lockstep): one
+    # fingerprint record per imminent train/eval dispatch.  unit is the
+    # dispatch site (train_step/train_epoch_fused/eval_step/feature_step),
+    # hash covers the cross-process-compared fields; digest/rng/step/task/
+    # epoch are present when the site provides them (None fields are
+    # stripped before logging).
+    "lockstep_fingerprint": (
+        {"unit": str, "program": str, "seq": NUM, "hash": str},
+        {"arg_sig": str, "digest": str, "rng": list, "step": NUM,
+         "task": NUM, "epoch": NUM},
+        None,
+    ),
+    # A process observed the fleet diverging (or a peer dead) at a dispatch
+    # boundary.  kind is fingerprint_mismatch (fields/mine/theirs name the
+    # disagreement) or peer_timeout (deadline_s elapsed with no peer
+    # fingerprint); emitted on every live process before any collective
+    # could hang, alongside a flight-recorder fatal dump.
+    "lockstep_violation": (
+        {"kind": str, "unit": str, "seq": NUM, "peer": NUM},
+        {"fields": list, "mine": dict, "theirs": dict, "deadline_s": NUM,
+         "step": NUM, "task": NUM, "epoch": NUM, "program": str},
+        None,
+    ),
+    # Prefetch producer death -> synchronous-path degradation
+    # (data/prefetch.py on_degrade hook, wired in engine/loop.py).
+    "prefetch_degraded": (
+        {"where": str, "error": str},
+        {"task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    # A checkpoint save failed transiently; the run continued (durability
+    # gap, logged so the evidence trail shows it).
+    "ckpt_save_error": (
+        {"error": str},
+        {"path": str, "task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    # Restore skipped an invalid (truncated/corrupt) checkpoint and fell
+    # back to the next-newest valid candidate.
+    "ckpt_fallback": ({"skipped": str, "reason": str}, {}, None),
+    "epoch": (
+        {"task_id": NUM, "epoch": NUM, "lr": NUM},
+        {
+            "epoch_s": NUM,
+            "host_s": NUM,
+            "device_s": NUM,
+            "stall_frac": NUM,
+        },
+        "numeric",
+    ),
+    "task": (
+        {
+            "task_id": NUM,
+            "acc1": NUM,
+            "acc1s": list,
+            "nb_new": NUM,
+            "known_after": NUM,
+            "seconds": NUM,
+        },
+        {"gamma": (int, float, type(None)), "acc_per_task": list},
+        None,
+    ),
+    "final": (
+        {"acc1s": list, "avg_incremental_acc1": NUM},
+        {
+            "nb_tasks": NUM,
+            "forgetting": (list, type(None)),
+            "bwt": (int, float, type(None)),
+            "partial": bool,
+            "tasks": list,
+        },
+        None,
+    ),
+    "cil_metrics": (
+        {"task_id": NUM, "avg_incremental_acc1": NUM},
+        {
+            "nb_tasks": NUM,
+            "forgetting": (list, type(None)),
+            "bwt": (int, float, type(None)),
+            "partial": bool,
+            "tasks": list,
+        },
+        None,
+    ),
+    "hbm": ({"devices": dict}, {"task_id": NUM}, None),
+    "profile_trace": (
+        {"path": str},
+        {"task_id": NUM, "name": str},
+        None,
+    ),
+    "recompile": (
+        {
+            "where": str,
+            "new_programs": NUM,
+            "total_programs": NUM,
+            "expected": bool,
+        },
+        {"group": str, "task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    "recompile_warning": (
+        {"where": str, "new_programs": NUM, "total_programs": NUM},
+        {"group": str, "task_id": NUM, "epoch": NUM},
+        None,
+    ),
+    # RecompileSentinel (analysis/runtime.py): trace-budget verdict at every
+    # check point — programs compiled vs the budget granted by task-growth /
+    # restore events.
+    "recompile_budget": (
+        {"where": str, "budget": NUM, "programs": NUM, "events": NUM,
+         "ok": bool},
+        {"group": str, "task_id": NUM},
+        None,
+    ),
+    # Compile-cost accounting (telemetry/compilewatch.py): net XLA work in a
+    # window — a task's first executed epoch (engine/loop.py) or a serving
+    # replica's AOT load (serving/replica.py, source="replica").  compile_s
+    # is backend compile time minus the share the persistent compilation
+    # cache served; ≈0 on a warm-cache resume, which is what
+    # scripts/perf_gate.py --compile and scripts/warmcache_smoke.py assert.
+    "compile_event": (
+        {"task_id": NUM, "compile_s": NUM, "backend_compile_s": NUM,
+         "cache_retrieval_s": NUM, "compiles": NUM, "cache_hits": NUM},
+        {"epoch": NUM, "resumed": bool, "source": str},
+        None,
+    ),
+    # Next-task device warm-start (engine/loop.py _warm_next_task): outcome
+    # of consuming the ring armed during the previous task's eval/herd
+    # window.  hit=True carries the placed bytes + how long the consumer
+    # waited; hit=False carries why the warm path degraded to the
+    # synchronous transfer (never fatal).
+    "prefetch_warm": (
+        {"task_id": NUM, "hit": bool},
+        {"reason": str, "bytes": NUM, "wait_s": NUM, "warm_s": NUM},
+        None,
+    ),
+    # bench.py --precision sweep: one record per run with a per-preset row
+    # (step_ms, loss_finite, short accuracy probe) under `results`.
+    "precision_ablation": (
+        {"results": list},
+        {"backend": str, "global_batch": NUM, "iters": NUM, "metric": str,
+         "selective_not_slower": bool, "reduced_cpu_fallback": bool},
+        None,
+    ),
+    # A fresh (non-resume) run archived the previous soak's spent fire
+    # ledger so the --fault_spec re-armed (faults.rotate_ledger).
+    "fault_ledger_rotated": ({"path": str, "archived": str}, {}, None),
+    "span": (
+        {"name": str, "span_id": NUM, "depth": NUM, "ts": NUM, "dur_s": NUM},
+        {"parent": (int, float, type(None))},
+        "any",  # span attrs (task=, epoch=, ...) ride along freely
+    ),
+    "heartbeat": (
+        {"ts": NUM, "seq": NUM, "pid": NUM},
+        {
+            "mono": NUM,  # monotonic stamp for cross-process clock alignment
+            "step": NUM,
+            "task": NUM,
+            "epoch": NUM,
+            "phase": str,
+            "last_step_ms": NUM,
+            "age_s": NUM,
+            "fresh": bool,
+            # Registry progress digest (telemetry/metrics.py MetricsPump):
+            # absolute counters + derived rates, so the supervisor's stall
+            # probe can tell "alive but stalled" (fresh beat, frozen
+            # counters) from "making progress" without scraping anything.
+            "steps_total": NUM,
+            "step_rate": NUM,
+            "serve_requests_total": NUM,
+            "serve_qps": NUM,
+        },
+        None,
+    ),
+    # Metrics-plane snapshot (telemetry/metrics.py MetricsPump): one atomic
+    # registry copy per cadence.  counters/gauges map Prometheus-style
+    # series names to values; histograms map them to exponential-bucket
+    # payloads ({count, sum, lowest, growth, buckets}); rates carries the
+    # per-second counter deltas vs the previous flush.
+    "metrics_snapshot": (
+        {"source": str, "counters": dict, "gauges": dict,
+         "histograms": dict},
+        {"seq": NUM, "interval_s": NUM, "rates": dict, "replica": NUM,
+         "up": dict},
+        None,
+    ),
+    # SLO burn-rate alert (scripts/metrics_agent.py): multi-window burn-rate
+    # evaluation tripped — the error budget is burning `burn_rate` times
+    # faster than the objective allows over both the long and short window.
+    "slo_burn": (
+        {"slo": str, "burn_rate": NUM, "threshold": NUM, "window_s": NUM},
+        {"severity": str, "short_window_s": NUM, "short_burn_rate": NUM,
+         "objective": NUM, "bad": NUM, "total": NUM},
+        None,
+    ),
+    # Flight recorder (telemetry/flight.py): the ring-buffer tail dumped on
+    # every death path (and each heartbeat).  `events` holds raw sink/span/
+    # heartbeat records — they are forensic payload, not re-validated here
+    # (a crash tail legitimately contains torn or partial records).
+    "flight_dump": (
+        {"reason": str, "pid": NUM, "events": list},
+        {
+            "capacity": NUM,
+            "dropped": NUM,
+            "open_spans": list,
+            "last_open_span": (str, type(None)),
+        },
+        None,
+    ),
+    # Supervisor harvest (scripts/supervise.py): flight dumps + heartbeats +
+    # fault ledger gathered into one artifact before each relaunch.
+    "crash_report": (
+        {"returncode": NUM, "hung": bool, "attempt": NUM},
+        {
+            "uptime_s": NUM,
+            "telemetry_dir": str,
+            "flight_dumps": list,
+            "heartbeats": list,
+            "fault_ledger": list,
+        },
+        None,
+    ),
+    # Serving (serving/ + engine/loop.py export hook).  One serve_export per
+    # task with --export_dir: either the artifact landed (path/known/...) or
+    # the export failed and training continued (error).
+    "serve_export": (
+        {"task_id": NUM},
+        {"path": str, "known": NUM, "buckets": list, "seconds": NUM,
+         "error": str},
+        None,
+    ),
+    # A successful artifact (hot-)swap; from_task is None for the initial
+    # load at server start.
+    "serve_swap": (
+        {"from_task": (int, float, type(None)), "to_task": NUM,
+         "load_ms": NUM, "compile_ms": NUM, "path": str},
+        {},
+        None,
+    ),
+    # A swap attempt failed (corrupt artifact, injected IOError): the server
+    # kept the current artifact and will retry at the next manifest poll.
+    "serve_swap_failed": ({"task_id": NUM, "error": str}, {}, None),
+    # Training/serving skew (serving/skew.py): accuracy re-measured through
+    # the exported artifact vs the trainer's accuracy row.  Zero skew is the
+    # healthy state — the exported program is the same computation.
+    "serve_skew": (
+        {"task_id": NUM, "served_acc1": NUM, "served_acc_per_task": list,
+         "n": NUM},
+        {"train_acc_per_task": (list, type(None)),
+         "skew_abs_max": (int, float, type(None))},
+        None,
+    ),
+    # Front-end admission control (serving/frontend.py): a request was
+    # rejected at admission.  Rate-limited (~2/s per class) with shed_total
+    # carrying the cumulative count, so overload does not amplify itself
+    # through its own telemetry.
+    "serve_shed": (
+        {"priority": str, "queued": NUM, "capacity": NUM},
+        {"shed_total": NUM},
+        None,
+    ),
+    # Fleet health transitions (serving/health.py): event is "eject" (the
+    # consecutive-error breaker tripped, or the replica's heartbeat went
+    # stale) or "readmit" (the out-of-band warm probe passed).
+    "replica_ejected": (
+        {"replica": NUM, "event": str, "reason": str},
+        {"consecutive_errors": NUM, "heartbeat_age_s": NUM},
+        None,
+    ),
+    # A skew-gated swap was refused and the replica kept (rolled back to)
+    # its previous artifact; emitted by the replica's swap_to and by the
+    # front end's rollout driver when a wave halts.
+    "serve_rollback": (
+        {"task_id": NUM, "rolled_back_to": (int, float, type(None)),
+         "reason": str},
+        {"replica": NUM, "probe_max_abs": NUM, "probe_checked": bool},
+        None,
+    ),
+    # One failed dispatch attempt inside a request's failover chain
+    # (serving/frontend.py); the request itself may still succeed.
+    "frontend_retry": (
+        {"replica": NUM, "attempt": NUM, "error": str},
+        {},
+        None,
+    ),
+    # Rolling latency window from the inference server's batcher.
+    "serve_latency": (
+        {"count": NUM, "p50_ms": NUM, "p95_ms": NUM, "p99_ms": NUM,
+         "throughput_rps": NUM},
+        {"bucket_occupancy": NUM, "batches": NUM, "task_id": NUM},
+        None,
+    ),
+}
+
+# Every JsonlLogger record carries a writer timestamp; spans/heartbeats
+# stamp their own.  "ts" is therefore universally required.
+ALWAYS_REQUIRED = {"ts": NUM}
+
+# Process-identity tags every record may carry since PR 6 (JsonlLogger
+# stamps all three; spans/heartbeats stamp process_index): optional so the
+# committed pre-fleet evidence logs stay valid.
+ALWAYS_OPTIONAL = {
+    "process_index": NUM,
+    "process_count": NUM,
+    "host_id": str,
+}
+
+
+def known_fields(rtype: str) -> frozenset:
+    """Every field name a record of ``rtype`` may legally carry (``type``
+    included); empty frozenset for an unknown type."""
+    spec = SCHEMA.get(rtype)
+    if spec is None:
+        return frozenset()
+    required, optional, _ = spec
+    return frozenset(required) | frozenset(optional) | \
+        frozenset(ALWAYS_REQUIRED) | frozenset(ALWAYS_OPTIONAL) | {"type"}
+
+
+def check_record(rec: dict, where: str) -> list:
+    """Validate one record dict; returns a list of violation strings."""
+    errs = []
+    rtype = rec.get("type")
+    if rtype not in SCHEMA:
+        return [f"{where}: unknown record type {rtype!r}"]
+    required, optional, extras = SCHEMA[rtype]
+    required = {**ALWAYS_REQUIRED, **required}
+    optional = {**ALWAYS_OPTIONAL, **optional}
+    for field, types in required.items():
+        if field not in rec:
+            errs.append(f"{where}: {rtype} record missing required {field!r}")
+        elif not isinstance(rec[field], types):
+            errs.append(
+                f"{where}: {rtype}.{field} has type "
+                f"{type(rec[field]).__name__}, want {types}"
+            )
+    for field, value in rec.items():
+        if field == "type" or field in required:
+            continue
+        if field in optional:
+            if not isinstance(value, optional[field]):
+                errs.append(
+                    f"{where}: {rtype}.{field} has type "
+                    f"{type(value).__name__}, want {optional[field]}"
+                )
+        elif extras is None:
+            errs.append(f"{where}: {rtype} record has undeclared field {field!r}")
+        elif extras == "numeric" and not isinstance(value, NUM):
+            errs.append(
+                f"{where}: {rtype} extra field {field!r} must be numeric, "
+                f"got {type(value).__name__}"
+            )
+    return errs

@@ -1,0 +1,249 @@
+"""contractcheck: runtime contract sentinel (``--check_contracts``).
+
+The dynamic half of contractlint (:mod:`analysis.contracts`).  The static
+pass can only see names written as constants; a record type or metric name
+built at runtime (``f"serve_{kind}"``, a name read from a config file)
+sails past the AST.  This sentinel closes that hole: ``install()`` loads
+the committed contract registry (``analysis/contract_registry.json``, the
+linter's exported vocabulary) and the engine wraps
+
+* its telemetry sink in :class:`CheckedSink` — every ``log(record_type,
+  **fields)`` is validated against the registry's record vocabulary at
+  emit time (unknown type; unknown field on a type whose schema entry
+  allows no extras);
+* its metrics registry in :class:`CheckedRegistry` — every
+  ``counter/gauge/histogram(name, **labels)`` registration is validated
+  against the registry's instrument table (unknown name; label-key set
+  never seen at any static registration site).
+
+Each violation is recorded once (deduplicated by kind+name+field), kept in
+``violations`` for asserts, and emitted as a schema-checked
+``contract_violation`` record through the real sink — with a reentrancy
+guard so an invalid record cannot recurse through its own violation report.
+The chaos and serve smokes run under ``--check_contracts`` and fail on any
+record.
+
+Mirrors the :mod:`analysis.threadcheck` conventions: module-global
+``install()``/``uninstall()``/``active()`` (idempotent), ``bind_sink()``
+flushing violations buffered before the sink existed.  A missing registry
+under ``--check_contracts`` fails loudly — regenerate with
+``python scripts/contractlint.py --write-registry``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Optional, Set, Tuple
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_REGISTRY_PATH = os.path.join(_THIS_DIR, "contract_registry.json")
+
+# Histogram constructor kwargs that are bucket shape, not labels (must match
+# analysis/contracts.py and telemetry/metrics.py).
+_HIST_KWARGS = {"lowest", "growth", "buckets"}
+
+_ACTIVE: Optional["ContractCheck"] = None
+
+
+def load_registry(path: Optional[str] = None) -> dict:
+    path = path or DEFAULT_REGISTRY_PATH
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"--check_contracts needs the contract registry at {path}; "
+            f"regenerate it with: python scripts/contractlint.py "
+            f"--write-registry")
+    with open(path) as f:
+        return json.load(f)
+
+
+class ContractCheck:
+    """Registry-backed validators + the violation channel.
+
+    Use the module-level :func:`install`/:func:`uninstall` rather than
+    instantiating directly; tests that need a fresh sentinel install,
+    assert on ``violations``, and uninstall in ``finally``.
+    """
+
+    def __init__(self, registry: dict, sink=None) -> None:
+        self.records: dict = registry.get("records", {})
+        self.metrics: dict = registry.get("metrics", {})
+        self.violations: List[dict] = []
+        self._tls = threading.local()
+        self._meta_lock = threading.Lock()
+        self._sink = sink
+        self._buffered: List[dict] = []
+        self._reported: Set[Tuple[str, str, str]] = set()
+
+    # ------------------------------------------------------------------ #
+    # Validators (called by the wrappers)
+    # ------------------------------------------------------------------ #
+
+    def on_record(self, rtype: str, fields: dict) -> None:
+        if getattr(self._tls, "emitting", False):
+            return
+        entry = self.records.get(rtype)
+        if entry is None:
+            self._report("unknown_record_type", rtype,
+                         detail=f"record type {rtype!r} is not in the "
+                                f"contract registry")
+            return
+        if entry.get("extras") in ("any", "numeric"):
+            return
+        known = entry.get("fields", ())
+        for f in fields:
+            if f not in known:
+                self._report("unknown_record_field", rtype, field=f,
+                             detail=f"field {f!r} is not in {rtype}'s "
+                                    f"registry vocabulary")
+
+    def on_metric(self, kind: str, name: str, labels: dict) -> None:
+        if getattr(self._tls, "emitting", False):
+            return
+        entry = self.metrics.get(name)
+        if entry is None:
+            self._report("unknown_metric", name,
+                         detail=f"{kind} {name!r} is not in the contract "
+                                f"registry")
+            return
+        if entry.get("dynamic_labels"):
+            return
+        keys = sorted(k for k in labels
+                      if not (kind == "histogram" and k in _HIST_KWARGS))
+        if keys and keys not in entry.get("label_sets", []):
+            self._report("metric_label_drift", name, labels=keys,
+                         detail=f"label-key set {keys} matches no "
+                                f"registration site of {name!r}")
+
+    # ------------------------------------------------------------------ #
+    # Reporting
+    # ------------------------------------------------------------------ #
+
+    def _report(self, kind: str, name: str, field: Optional[str] = None,
+                detail: Optional[str] = None,
+                labels: Optional[list] = None) -> None:
+        key = (kind, name, field or "")
+        violation = {"kind": kind, "name": name}
+        if field is not None:
+            violation["field"] = field
+        if detail is not None:
+            violation["detail"] = detail
+        if labels is not None:
+            violation["labels"] = labels
+        with self._meta_lock:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+            self.violations.append(violation)
+            sink = self._sink
+            if sink is None:
+                self._buffered.append(violation)
+        if sink is not None:
+            self._log(violation)
+
+    def bind_sink(self, sink) -> None:
+        """Attach the telemetry sink; violations recorded before the sink
+        existed are flushed."""
+        with self._meta_lock:
+            self._sink = sink
+            pending, self._buffered = self._buffered, []
+        for v in pending:
+            self._log(v)
+
+    def _log(self, violation: dict) -> None:
+        # The violation record travels through the real (possibly wrapped)
+        # sink; the guard keeps its own emission from being re-validated —
+        # a contract_violation about contract_violation would recurse.
+        self._tls.emitting = True
+        try:
+            with self._meta_lock:
+                sink = self._sink
+            if sink is not None:
+                sink.log("contract_violation", **violation)
+        finally:
+            self._tls.emitting = False
+
+
+class CheckedSink:
+    """Delegating sink wrapper: validates every record at emit time."""
+
+    def __init__(self, inner, check: ContractCheck) -> None:
+        self._inner = inner
+        self._check = check
+
+    def log(self, record_type: str, **fields) -> None:
+        self._check.on_record(record_type, fields)
+        return self._inner.log(record_type, **fields)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class CheckedRegistry:
+    """Delegating metrics-registry wrapper: validates every instrument
+    registration (name + label-key set) against the contract registry."""
+
+    def __init__(self, inner, check: ContractCheck) -> None:
+        self._inner = inner
+        self._check = check
+
+    def counter(self, name: str, **labels):
+        self._check.on_metric("counter", name, labels)
+        return self._inner.counter(name, **labels)
+
+    def gauge(self, name: str, **labels):
+        self._check.on_metric("gauge", name, labels)
+        return self._inner.gauge(name, **labels)
+
+    def histogram(self, name: str, **kwargs):
+        self._check.on_metric("histogram", name, kwargs)
+        return self._inner.histogram(name, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# --------------------------------------------------------------------------- #
+# Process-global install
+# --------------------------------------------------------------------------- #
+
+
+def install(registry_path: Optional[str] = None,
+            sink=None) -> ContractCheck:
+    """Install the sentinel process-wide (idempotent); then route the
+    engine's sink/registry through :func:`wrap_sink`/:func:`wrap_registry`
+    and ``bind_sink()`` once the telemetry sink exists."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        if sink is not None:
+            _ACTIVE.bind_sink(sink)
+        return _ACTIVE
+    check = ContractCheck(load_registry(registry_path), sink=sink)
+    _ACTIVE = check
+    return check
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[ContractCheck]:
+    return _ACTIVE
+
+
+def wrap_sink(sink):
+    """Wrap a telemetry sink in the validator, or return it unchanged when
+    the sentinel is not installed."""
+    if _ACTIVE is None or isinstance(sink, CheckedSink):
+        return sink
+    return CheckedSink(sink, _ACTIVE)
+
+
+def wrap_registry(registry):
+    """Wrap a metrics registry in the validator, or return it unchanged
+    when the sentinel is not installed."""
+    if _ACTIVE is None or isinstance(registry, CheckedRegistry):
+        return registry
+    return CheckedRegistry(registry, _ACTIVE)

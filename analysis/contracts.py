@@ -1,0 +1,905 @@
+"""contractlint: cross-artifact producer/consumer contract analysis (JL501-JL506).
+
+Stdlib-only, like the rest of jaxlint.  The framework is held together by
+stringly-typed contracts: telemetry record types + fields (vocabulary in
+``telemetry/schema.py``), config flags (``config.py`` dataclass + argparse),
+fault-site names (``faults/injector.py`` ACTIONS grammar), and metric
+instrument names + label sets (registered in ``telemetry/metrics.py``,
+consumed by ``scripts/metrics_agent.py`` / ``perf_gate.py`` /
+``report_run.py`` / ``bench.py``).  Every prior lint tier guarded a runtime
+hazard class; this one guards *drift between producers and consumers of
+these names* — the failure mode that silently blanks a report panel, skips
+a perf gate, or turns a fault spec into a no-op.
+
+The pass builds one project-wide **contract registry** (exported as
+``analysis/contract_registry.json`` and consumed at runtime by the
+``--check_contracts`` sentinel, ``analysis/contractcheck.py``):
+
+* every telemetry record type emitted (``sink.log("t", ...)`` /
+  ``self._log("t", payload)`` attribute calls, ``{"type": "t", ...}`` dict
+  literals, ``rec["type"] = "t"`` stores) and every type the schema knows;
+* every config field defined (``*Config`` dataclass in a ``config.py``) and
+  every argparse dest/option string, vs. every ``cfg``/``config``/``args``
+  attribute read;
+* every fault site the injector ACTIONS grammar documents vs. every site
+  ``.fire()`` / ``.reconcile_steps()`` actually names;
+* every metric instrument registered (``.counter/.gauge/.histogram("name",
+  **labels)``) with its label-key set, vs. every name scraped, gated, or
+  asserted (``sum_series``/``sum_counters`` args, name comparisons, SLO spec
+  JSON strings, and ``BASELINE.json`` ``hist_p99*`` gate keys).
+
+Rules (see README "Static analysis"):
+
+* JL501 — a record type emitted that ``telemetry/schema.py`` does not know
+  (the schema checker would fail the evidence log in CI), or the reverse: a
+  schema entry no emitter in the lint scope reaches (stale vocabulary).
+* JL502 — a consumer reads a record field outside the schema vocabulary of
+  the record type(s) it filtered on (``[r for r in recs if r.get("type") ==
+  "epoch"]`` followed by ``r["lrr"]`` renders nothing, silently).  Types
+  whose schema entry allows free-form extras ("any"/"numeric") are exempt.
+* JL503 — a config field defined but never read anywhere (dead flag), or a
+  ``cfg``/``config``/``args`` attribute read that no dataclass field,
+  ``add_argument`` dest, or attribute store defines (typo'd flag read).
+* JL504 — a fault site fired that the injector ACTIONS grammar does not
+  know (the clause can never arm), or a documented site never fired
+  anywhere (the grammar over-promises).
+* JL505 — metric instrument drift: a name consumed at a scrape/gate site
+  that no registration defines, the same name registered with differing
+  label-key sets, or a ``BASELINE.json`` ``hist_p99*`` gate key whose
+  source histogram is not registered.
+* JL506 — README documents a ``--flag``, a ``JLxxx`` rule id, or a
+  ``record_type`` record that no longer exists.
+
+All artifacts are optional: fixture projects without a schema module,
+README.md, or BASELINE.json simply skip the rules that need them.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding, is_suppressed, parse_suppressions
+from .linter import discover
+from .rules import RULES
+
+CONTRACT_RULES = {
+    "JL501": "telemetry record type emitted that the schema does not know "
+             "(or schema entry no emitter reaches)",
+    "JL502": "consumer reads a record field outside the schema vocabulary "
+             "of the type(s) it filtered on",
+    "JL503": "config field defined but never read, or cfg/args attribute "
+             "read that nothing defines",
+    "JL504": "fault site fired that the injector ACTIONS grammar does not "
+             "know (or documented site never fired)",
+    "JL505": "metric instrument name or label-set drift between "
+             "registration and scrape/gate sites",
+    "JL506": "README documents a flag, record type, or rule id that no "
+             "longer exists",
+}
+
+DEFAULT_BASELINE = os.path.join("analysis", "contractlint_baseline.json")
+DEFAULT_REGISTRY = os.path.join("analysis", "contract_registry.json")
+
+# Which perf-gate BASELINE.json histogram keys derive from which registered
+# instrument (scripts/perf_gate.py --serve/--serve-overload).
+_GATE_HISTOGRAMS = {
+    "serve_gate": "serve_batch_latency_ms",
+    "serve_overload_gate": "fe_latency_ms",
+}
+
+# Modules whose metric-shaped string constants count as consumption sites.
+_METRIC_CONSUMERS = {
+    "metrics_agent.py", "report_run.py", "perf_gate.py", "supervise.py",
+    "bench.py", "serve_smoke.py", "chaos_smoke.py", "warmcache_smoke.py",
+    "summarize_results.py",
+}
+
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*_(total|ms|frac|qps|rps)$")
+# Variables whose comparison against a string constant marks a metric-name
+# consumption (`if name == "fe_latency_ms"`, `_split_series(k)[0] == ...`).
+_SERIES_VAR_NAMES = {"name", "k", "key", "base", "series"}
+
+_HIST_KWARGS = {"lowest", "growth", "buckets"}
+
+# ``=`` in the lookbehind skips env-var values (XLA_FLAGS=--xla_...): those
+# document someone else's flag grammar, not ours.
+_README_FLAG_RE = re.compile(r"(?<![\w=-])--([A-Za-z][A-Za-z0-9_-]*)")
+_README_RULE_RE = re.compile(r"\bJL\d{3}\b")
+_README_RECORD_RE = re.compile(r"`([a-z_][a-z0-9_]*)`\s+records?\b")
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@dataclass
+class SchemaEntry:
+    line: int
+    fields: Set[str]   # required + optional + always + "type"
+    extras: Optional[str]  # None | "any" | "numeric"
+
+
+@dataclass
+class ContractIndex:
+    """Everything the JL5xx rules compare, extracted in one AST sweep."""
+
+    schema_path: Optional[str] = None
+    schema: Dict[str, SchemaEntry] = field(default_factory=dict)
+    always_fields: Set[str] = field(default_factory=set)
+    # (rel, line, col, record_type)
+    emits: List[Tuple[str, int, int, str]] = field(default_factory=list)
+    # config contract
+    config_fields: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    # fields of *Config dataclasses outside config.py (AugmentConfig, ...):
+    # legal on a cfg receiver, but not subject to the dead-field check
+    other_config_fields: Set[str] = field(default_factory=set)
+    config_methods: Set[str] = field(default_factory=set)
+    arg_dests: Set[str] = field(default_factory=set)
+    option_strings: Set[str] = field(default_factory=set)  # normalized
+    attr_reads: Set[str] = field(default_factory=set)
+    getattr_reads: Set[str] = field(default_factory=set)
+    cfg_reads: List[Tuple[str, int, int, str]] = field(default_factory=list)
+    cfg_writes: Set[str] = field(default_factory=set)
+    # fault contract
+    action_sites: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    fired: List[Tuple[str, int, int, str]] = field(default_factory=list)
+    # metrics contract: name -> [(rel, line, col, kind, labelkeys|None)]
+    metric_regs: Dict[str, List[Tuple[str, int, int, str,
+                                      Optional[Tuple[str, ...]]]]] = \
+        field(default_factory=dict)
+    metric_uses: List[Tuple[str, int, int, str]] = field(default_factory=list)
+
+    def schema_fields_union(self) -> Set[str]:
+        out: Set[str] = set()
+        for ent in self.schema.values():
+            out |= ent.fields
+        return out
+
+
+# --------------------------------------------------------------------------
+# extraction
+
+def _parse_schema_dict(node: ast.Dict) -> Dict[str, SchemaEntry]:
+    out: Dict[str, SchemaEntry] = {}
+    for k, v in zip(node.keys, node.values):
+        rtype = _const_str(k)
+        if rtype is None:
+            continue
+        fields: Set[str] = {"type"}
+        extras: Optional[str] = None
+        if isinstance(v, ast.Tuple) and len(v.elts) >= 2:
+            for d in v.elts[:2]:
+                if isinstance(d, ast.Dict):
+                    for fk in d.keys:
+                        s = _const_str(fk)
+                        if s is not None:
+                            fields.add(s)
+            if len(v.elts) >= 3:
+                e = v.elts[2]
+                if isinstance(e, ast.Constant):
+                    extras = e.value
+        out[rtype] = SchemaEntry(line=k.lineno, fields=fields, extras=extras)
+    return out
+
+
+def _argparse_dest(call: ast.Call) -> Tuple[Optional[str], List[str]]:
+    """(dest, normalized option strings) of one ``add_argument`` call."""
+    opts = [s for s in (_const_str(a) for a in call.args) if s is not None]
+    norm = [o.lstrip("-").replace("-", "_") for o in opts if o.startswith("-")]
+    dest = None
+    for kw in call.keywords:
+        if kw.arg == "dest":
+            dest = _const_str(kw.value)
+    if dest is None:
+        longs = [o for o in opts if o.startswith("--")]
+        if longs:
+            dest = longs[0][2:].replace("-", "_")
+        elif opts and not opts[0].startswith("-"):
+            dest = opts[0]  # positional
+    return dest, norm
+
+
+def _scan_module(rel: str, tree: ast.Module, idx: ContractIndex) -> None:
+    basename = os.path.basename(rel)
+    consumer = basename in _METRIC_CONSUMERS
+
+    # top-level contract tables: SCHEMA / ALWAYS_* / ACTIONS (plain or
+    # annotated assignments — ``ACTIONS: Dict[str, frozenset] = {...}``)
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            tname = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and stmt.value is not None:
+            tname = stmt.target.id
+        else:
+            continue
+        if tname == "SCHEMA" and isinstance(stmt.value, ast.Dict):
+            parsed = _parse_schema_dict(stmt.value)
+            # Prefer the canonical telemetry/schema.py over any other module
+            # carrying a SCHEMA table (fixtures may have exactly one).
+            if (idx.schema_path is None
+                    or rel.endswith("telemetry/schema.py")):
+                idx.schema_path = rel
+                idx.schema = parsed
+        elif tname in ("ALWAYS_REQUIRED", "ALWAYS_OPTIONAL") and \
+                isinstance(stmt.value, ast.Dict):
+            for k in stmt.value.keys:
+                s = _const_str(k)
+                if s is not None:
+                    idx.always_fields.add(s)
+        elif tname == "ACTIONS" and isinstance(stmt.value, ast.Dict):
+            for sub in ast.walk(stmt.value):
+                s = _const_str(sub)
+                if s is not None and "." in s:
+                    idx.action_sites.setdefault(s, (rel, sub.lineno))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Config"):
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and \
+                        isinstance(item.target, ast.Name):
+                    if basename == "config.py":
+                        idx.config_fields.setdefault(
+                            item.target.id, (rel, item.lineno))
+                    else:
+                        idx.other_config_fields.add(item.target.id)
+                elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    idx.config_methods.add(item.name)
+
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if isinstance(fn, ast.Attribute) and leaf in ("log", "_log") \
+                    and node.args:
+                rt = _const_str(node.args[0])
+                if rt is not None:
+                    idx.emits.append((rel, node.lineno, node.col_offset, rt))
+            if isinstance(fn, ast.Attribute) and \
+                    leaf in ("counter", "gauge", "histogram") and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    labels: Optional[List[str]] = []
+                    for kw in node.keywords:
+                        if kw.arg is None:
+                            labels = None  # **dynamic labels
+                            break
+                        if leaf == "histogram" and kw.arg in _HIST_KWARGS:
+                            continue
+                        labels.append(kw.arg)
+                    idx.metric_regs.setdefault(name, []).append(
+                        (rel, node.lineno, node.col_offset, leaf,
+                         tuple(sorted(labels)) if labels is not None
+                         else None))
+            if leaf in ("fire", "reconcile_steps") and node.args:
+                s = _const_str(node.args[0])
+                if s is not None and "." in s:
+                    idx.fired.append((rel, node.lineno, node.col_offset, s))
+            if consumer and leaf in ("sum_series", "sum_counters") and \
+                    len(node.args) >= 2:
+                s = _const_str(node.args[1])
+                if s is not None:
+                    idx.metric_uses.append(
+                        (rel, node.args[1].lineno, node.args[1].col_offset, s))
+            if isinstance(fn, ast.Attribute) and leaf == "add_argument":
+                dest, norm = _argparse_dest(node)
+                if dest:
+                    idx.arg_dests.add(dest)
+                idx.option_strings.update(norm)
+            if isinstance(fn, ast.Name) and fn.id == "getattr" and \
+                    len(node.args) >= 2:
+                s = _const_str(node.args[1])
+                if s is not None:
+                    idx.getattr_reads.add(s)
+            if leaf == "index" and node.args:
+                # hand-rolled CLIs: argv.index("--jaxlint")
+                s = _const_str(node.args[0])
+                if s is not None and s.startswith("--"):
+                    idx.option_strings.add(s[2:].replace("-", "_"))
+
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if _const_str(k) == "type":
+                    rt = _const_str(v)
+                    if rt is not None:
+                        idx.emits.append(
+                            (rel, v.lineno, v.col_offset, rt))
+
+        elif isinstance(node, ast.Assign):
+            # rec["type"] = "slo_burn" (metrics_agent idiom)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        _const_str(tgt.slice) == "type":
+                    rt = _const_str(node.value)
+                    if rt is not None:
+                        idx.emits.append(
+                            (rel, node.lineno, node.col_offset, rt))
+
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn, ast.Eq)):
+            # hand-rolled CLIs: "--jaxlint" in argv
+            s = _const_str(node.left)
+            if s is not None and s.startswith("--"):
+                idx.option_strings.add(s[2:].replace("-", "_"))
+
+        elif isinstance(node, ast.Attribute):
+            if isinstance(node.ctx, ast.Load):
+                idx.attr_reads.add(node.attr)
+            recv = None
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("cfg", "config",
+                                                          "args"):
+                recv = base.id
+            elif isinstance(base, ast.Attribute) and \
+                    base.attr in ("cfg", "config", "args") and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                recv = base.attr
+            if recv is not None:
+                if isinstance(node.ctx, ast.Load):
+                    idx.cfg_reads.append(
+                        (rel, node.lineno, node.col_offset, node.attr))
+                else:
+                    idx.cfg_writes.add(node.attr)
+
+    if consumer:
+        _scan_metric_strings(rel, tree, idx)
+
+
+def _scan_metric_strings(rel: str, tree: ast.Module,
+                         idx: ContractIndex) -> None:
+    """Metric-name consumption beyond sum_series/sum_counters calls:
+    name comparisons, all-metric-shaped name tuples, SLO-spec JSON strings."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            for a, b in ((node.left, node.comparators[0]),
+                         (node.comparators[0], node.left)):
+                s = _const_str(a)
+                if s is None or not _METRIC_NAME_RE.match(s):
+                    continue
+                mentions_series = (
+                    isinstance(b, ast.Name) and b.id in _SERIES_VAR_NAMES
+                ) or any(
+                    isinstance(n, (ast.Name, ast.Attribute)) and
+                    "series" in (n.id if isinstance(n, ast.Name) else n.attr)
+                    for n in ast.walk(b)
+                )
+                if mentions_series:
+                    idx.metric_uses.append(
+                        (rel, a.lineno, a.col_offset, s))
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            if isinstance(it, (ast.Tuple, ast.List)) and len(it.elts) >= 2:
+                names = [_const_str(e) for e in it.elts]
+                if all(n is not None and _METRIC_NAME_RE.match(n)
+                       for n in names):
+                    for e, n in zip(it.elts, names):
+                        idx.metric_uses.append(
+                            (rel, e.lineno, e.col_offset, n))
+        elif isinstance(node, ast.Dict):
+            # SLO specs built as dict literals ({"bad": "fe_shed_total"})
+            for k, v in zip(node.keys, node.values):
+                if _const_str(k) in ("bad", "total", "metric", "series"):
+                    s = _const_str(v)
+                    if s is not None and _METRIC_NAME_RE.match(s):
+                        idx.metric_uses.append(
+                            (rel, v.lineno, v.col_offset, s))
+        elif isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.lstrip().startswith("{"):
+            try:
+                spec = json.loads(node.value)
+            except ValueError:
+                continue
+            if isinstance(spec, dict):
+                for key in ("bad", "total", "metric", "series"):
+                    v = spec.get(key)
+                    if isinstance(v, str) and _METRIC_NAME_RE.match(v):
+                        idx.metric_uses.append(
+                            (rel, node.lineno, node.col_offset, v))
+
+
+# --------------------------------------------------------------------------
+# JL502: record-field reads vs the schema vocabulary
+
+def _type_filter(test: ast.AST) -> Optional[Tuple[str, Set[str]]]:
+    """``<v>.get("type") == "X"`` / ``<v>["type"] in ("X", "Y")`` ->
+    (varname, {types}); None for anything else."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return None
+    op = test.ops[0]
+    left, right = test.left, test.comparators[0]
+    for a, b in ((left, right), (right, left)):
+        var = _type_access_var(a)
+        if var is None:
+            continue
+        if isinstance(op, ast.Eq):
+            s = _const_str(b)
+            if s is not None:
+                return var, {s}
+        elif isinstance(op, ast.In) and a is left and \
+                isinstance(b, (ast.Tuple, ast.List, ast.Set)):
+            types = {s for s in (_const_str(e) for e in b.elts)
+                     if s is not None}
+            if types:
+                return var, types
+    return None
+
+
+def _type_access_var(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "get" and node.args and \
+            _const_str(node.args[0]) == "type" and \
+            isinstance(node.func.value, ast.Name):
+        return node.func.value.id
+    if isinstance(node, ast.Subscript) and \
+            _const_str(node.slice) == "type" and \
+            isinstance(node.value, ast.Name):
+        return node.value.id
+    return None
+
+
+def _elem_types(expr: ast.AST, env: Dict[str, Set[str]],
+                idx: ContractIndex) -> Optional[Set[str]]:
+    """Record type(s) tagged on an expression, or None when untyped."""
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id)
+    if isinstance(expr, ast.Subscript):
+        key = _const_str(expr.slice)
+        if key is not None and key in idx.schema and \
+                isinstance(expr.value, ast.Name) and \
+                ("by_type" in expr.value.id or "by_kind" in expr.value.id):
+            return {key}
+        if key is None or isinstance(expr.slice, ast.Slice) or \
+                isinstance(getattr(expr.slice, "value", None), int):
+            return _elem_types(expr.value, env, idx)
+        return None
+    if isinstance(expr, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        local = _comp_bindings(expr, env, idx)
+        if isinstance(expr.elt, ast.Name):
+            return local.get(expr.elt.id) or env.get(expr.elt.id)
+        return None
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        if isinstance(fn, ast.Name) and fn.id in ("next", "sorted", "list",
+                                                  "reversed") and expr.args:
+            return _elem_types(expr.args[0], env, idx)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _elem_types(expr.left, env, idx)
+        right = _elem_types(expr.right, env, idx)
+        if left or right:
+            return set(left or ()) | set(right or ())
+    return None
+
+
+def _comp_bindings(comp: ast.AST, env: Dict[str, Set[str]],
+                   idx: ContractIndex) -> Dict[str, Set[str]]:
+    local: Dict[str, Set[str]] = {}
+    for gen in comp.generators:
+        if not isinstance(gen.target, ast.Name):
+            continue
+        merged = dict(env)
+        merged.update(local)
+        types = _elem_types(gen.iter, merged, idx)
+        ftypes: Set[str] = set()
+        for iftest in gen.ifs:
+            tf = _type_filter(iftest)
+            if tf is not None and tf[0] == gen.target.id:
+                ftypes |= tf[1]
+        if ftypes:
+            local[gen.target.id] = ftypes
+        elif types:
+            local[gen.target.id] = set(types)
+    return local
+
+
+def _scope_nodes(scope: ast.AST):
+    """Child statements of a scope, not descending into nested functions."""
+    for child in ast.iter_child_nodes(scope):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+            yield from _scope_nodes(child)
+
+
+def _record_read_findings(rel: str, tree: ast.Module,
+                          idx: ContractIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        # Flow-insensitive: a name rebound to several record streams in one
+        # scope (``for rec in latency: ... for rec in skew: ...``) carries
+        # the UNION of their types, and a read passes if any candidate type
+        # carries the field — imprecise but false-positive-free.
+        env: Dict[str, Set[str]] = {}
+        for _ in range(2):  # two passes so chained bindings resolve
+            for node in _scope_nodes(scope):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    t = _elem_types(node.value, env, idx)
+                    if t:
+                        env[node.targets[0].id] = \
+                            env.get(node.targets[0].id, set()) | set(t)
+                elif isinstance(node, ast.For) and \
+                        isinstance(node.target, ast.Name):
+                    t = _elem_types(node.iter, env, idx)
+                    if t:
+                        env[node.target.id] = \
+                            env.get(node.target.id, set()) | set(t)
+
+        def check(types: Set[str], fieldname: str, node: ast.AST) -> None:
+            known = [idx.schema[t] for t in types if t in idx.schema]
+            if not known:
+                return
+            for ent in known:
+                if ent.extras in ("any", "numeric") or \
+                        fieldname in ent.fields or \
+                        fieldname in idx.always_fields:
+                    return
+            findings.append(Finding(
+                rel, node.lineno, node.col_offset, "JL502",
+                f"reads field '{fieldname}' that no "
+                f"{'/'.join(sorted(types))} record carries "
+                f"(per the telemetry schema)"))
+
+        def visit(node: ast.AST, overlay: Dict[str, Set[str]]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node is not scope:
+                return
+            merged = dict(env)
+            merged.update(overlay)
+            if isinstance(node, ast.If):
+                tf = _type_filter(node.test)
+                visit(node.test, overlay)
+                body_overlay = dict(overlay)
+                if tf is not None:
+                    body_overlay[tf[0]] = tf[1]
+                for n in node.body:
+                    visit(n, body_overlay)
+                for n in node.orelse:
+                    visit(n, overlay)
+                return
+            if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                 ast.SetComp, ast.DictComp)):
+                local = dict(overlay)
+                local.update(_comp_bindings(node, merged, idx))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, local)
+                return
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                fieldname = _const_str(node.slice)
+                if fieldname is not None:
+                    t = _elem_types(node.value, merged, idx)
+                    if t:
+                        check(t, fieldname, node)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args:
+                fieldname = _const_str(node.args[0])
+                if fieldname is not None:
+                    t = _elem_types(node.func.value, merged, idx)
+                    if t:
+                        check(t, fieldname, node)
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                fieldname = _const_str(node.left)
+                if fieldname is not None:
+                    t = _elem_types(node.comparators[0], merged, idx)
+                    if t:
+                        check(t, fieldname, node.left)
+            for child in ast.iter_child_nodes(node):
+                visit(child, overlay)
+
+        for stmt in (scope.body if hasattr(scope, "body") else []):
+            visit(stmt, {})
+    return findings
+
+
+# --------------------------------------------------------------------------
+# rules over the index
+
+# Attribute names legal on cfg/config/args receivers without a flag of their
+# own: argparse Namespace internals and dataclass plumbing.
+_CFG_ATTR_ALLOW = {"__dict__", "__class__", "__dataclass_fields__"}
+
+
+def _rule_jl501(idx: ContractIndex) -> List[Finding]:
+    if idx.schema_path is None:
+        return []
+    out: List[Finding] = []
+    emitted_types: Set[str] = set()
+    for rel, line, col, rtype in idx.emits:
+        emitted_types.add(rtype)
+        if rtype not in idx.schema:
+            out.append(Finding(
+                rel, line, col, "JL501",
+                f"record type '{rtype}' emitted but unknown to the "
+                f"telemetry schema ({idx.schema_path})"))
+    for rtype, ent in idx.schema.items():
+        if rtype not in emitted_types:
+            out.append(Finding(
+                idx.schema_path, ent.line, 0, "JL501",
+                f"schema entry '{rtype}' has no emitter in the lint scope "
+                f"(stale vocabulary?)"))
+    return out
+
+
+def _rule_jl503(idx: ContractIndex) -> List[Finding]:
+    out: List[Finding] = []
+    reads = idx.attr_reads | idx.getattr_reads
+    for name, (rel, line) in sorted(idx.config_fields.items()):
+        if name not in reads:
+            out.append(Finding(
+                rel, line, 0, "JL503",
+                f"config field '{name}' is defined but never read"))
+    defined = (set(idx.config_fields) | idx.other_config_fields
+               | idx.arg_dests | idx.config_methods | idx.cfg_writes
+               | _CFG_ATTR_ALLOW)
+    seen: Set[Tuple[str, int, str]] = set()
+    for rel, line, col, attr in idx.cfg_reads:
+        if attr in defined or attr.startswith("_"):
+            continue
+        key = (rel, line, attr)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Finding(
+            rel, line, col, "JL503",
+            f"attribute '{attr}' read from a config/args object but no "
+            f"config field or add_argument defines it"))
+    return out
+
+
+def _rule_jl504(idx: ContractIndex) -> List[Finding]:
+    if not idx.action_sites:
+        return []
+    out: List[Finding] = []
+    fired_sites: Set[str] = set()
+    for rel, line, col, site in idx.fired:
+        fired_sites.add(site)
+        if site not in idx.action_sites:
+            out.append(Finding(
+                rel, line, col, "JL504",
+                f"fault site '{site}' fired but the injector ACTIONS "
+                f"grammar does not know it"))
+    for site, (rel, line) in sorted(idx.action_sites.items()):
+        if site not in fired_sites:
+            out.append(Finding(
+                rel, line, 0, "JL504",
+                f"fault site '{site}' documented in ACTIONS but never "
+                f"fired in the lint scope"))
+    return out
+
+
+def _rule_jl505(idx: ContractIndex, root: str) -> List[Finding]:
+    out: List[Finding] = []
+    schema_fields = idx.schema_fields_union() | idx.always_fields
+    registered = set(idx.metric_regs)
+    for rel, line, col, name in idx.metric_uses:
+        if name in registered or name in schema_fields:
+            continue
+        out.append(Finding(
+            rel, line, col, "JL505",
+            f"metric '{name}' consumed here but never registered on any "
+            f"MetricsRegistry"))
+    for name, regs in sorted(idx.metric_regs.items()):
+        label_sets = {labels for _, _, _, _, labels in regs
+                      if labels is not None}
+        if len(label_sets) > 1:
+            shown = sorted(sorted(ls) for ls in label_sets)
+            for rel, line, col, _, labels in sorted(regs)[1:]:
+                if labels is None:
+                    continue
+                out.append(Finding(
+                    rel, line, col, "JL505",
+                    f"metric '{name}' registered with differing label-key "
+                    f"sets across sites: {shown}"))
+        kinds = {kind for _, _, _, kind, _ in regs}
+        if len(kinds) > 1:
+            rel, line, col, _, _ = sorted(regs)[1]
+            out.append(Finding(
+                rel, line, col, "JL505",
+                f"metric '{name}' registered as different instrument kinds "
+                f"across sites: {sorted(kinds)}"))
+    out.extend(_baseline_hist_findings(idx, root))
+    return out
+
+
+def _baseline_hist_findings(idx: ContractIndex, root: str) -> List[Finding]:
+    path = os.path.join(root, "BASELINE.json")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            text = f.read()
+        data = json.loads(text)
+    except (OSError, ValueError):
+        return []
+    lines = text.splitlines()
+
+    def line_of(token: str) -> int:
+        for n, ln in enumerate(lines, 1):
+            if f'"{token}"' in ln:
+                return n
+        return 1
+
+    out: List[Finding] = []
+    if not isinstance(data, dict):
+        return out
+    for gate, payload in data.items():
+        if not isinstance(payload, dict):
+            continue
+        for key in payload:
+            if not key.startswith("hist_p99"):
+                continue
+            hist = _GATE_HISTOGRAMS.get(gate)
+            if hist is None:
+                out.append(Finding(
+                    "BASELINE.json", line_of(key), 0, "JL505",
+                    f"gate '{gate}' carries '{key}' but no histogram "
+                    f"instrument is mapped to it (extend contractlint's "
+                    f"gate table)"))
+            elif hist not in idx.metric_regs:
+                out.append(Finding(
+                    "BASELINE.json", line_of(key), 0, "JL505",
+                    f"gate '{gate}' key '{key}' derives from histogram "
+                    f"'{hist}' which is not registered anywhere"))
+    return out
+
+
+def _rule_jl506(idx: ContractIndex, root: str) -> List[Finding]:
+    path = os.path.join(root, "README.md")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    known_rules = set(RULES) | set(CONTRACT_RULES)
+    known_flags = idx.option_strings | idx.arg_dests | set(idx.config_fields)
+    out: List[Finding] = []
+    in_code_fence = False
+    for n, ln in enumerate(lines, 1):
+        if ln.lstrip().startswith("```"):
+            in_code_fence = not in_code_fence
+        for m in _README_FLAG_RE.finditer(ln):
+            flag = m.group(1).replace("-", "_")
+            if flag not in known_flags:
+                out.append(Finding(
+                    "README.md", n, m.start(), "JL506",
+                    f"documented flag '--{m.group(1)}' matches no "
+                    f"add_argument option or config field"))
+        for m in _README_RULE_RE.finditer(ln):
+            if m.group(0) not in known_rules:
+                out.append(Finding(
+                    "README.md", n, m.start(), "JL506",
+                    f"documented rule id '{m.group(0)}' does not exist"))
+        if idx.schema:
+            for m in _README_RECORD_RE.finditer(ln):
+                if m.group(1) not in idx.schema:
+                    out.append(Finding(
+                        "README.md", n, m.start(), "JL506",
+                        f"documented record type '{m.group(1)}' is not in "
+                        f"the telemetry schema"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the registry + driver
+
+def build_registry(idx: ContractIndex) -> dict:
+    """The committed contract registry (analysis/contract_registry.json):
+    what the runtime ContractSentinel validates live emissions against.
+    Deterministic: every collection is sorted, json dumped with
+    sort_keys."""
+    emitters: Dict[str, List[str]] = {}
+    for rel, line, _, rtype in idx.emits:
+        emitters.setdefault(rtype, []).append(f"{rel}:{line}")
+    records = {}
+    for rtype, ent in idx.schema.items():
+        records[rtype] = {
+            "fields": sorted(ent.fields | idx.always_fields),
+            "extras": ent.extras,
+            "emitters": sorted(set(emitters.get(rtype, []))),
+        }
+    metrics = {}
+    for name, regs in idx.metric_regs.items():
+        label_sets = sorted({tuple(sorted(labels))
+                             for _, _, _, _, labels in regs
+                             if labels is not None})
+        metrics[name] = {
+            "kinds": sorted({kind for _, _, _, kind, _ in regs}),
+            "label_sets": [list(ls) for ls in label_sets],
+            "dynamic_labels": any(labels is None
+                                  for _, _, _, _, labels in regs),
+            "sites": sorted({f"{rel}:{line}"
+                             for rel, line, _, _, _ in regs}),
+        }
+    return {
+        "version": 1,
+        "generated_by": "scripts/contractlint.py --write-registry",
+        "records": {k: records[k] for k in sorted(records)},
+        "metrics": {k: metrics[k] for k in sorted(metrics)},
+        "config_fields": sorted(idx.config_fields),
+        "argparse_dests": sorted(idx.arg_dests),
+        "fault_sites": sorted(idx.action_sites),
+    }
+
+
+def write_registry(registry: dict, path: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(registry, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def lint_contracts(paths: Iterable[str],
+                   root: str = ".") -> Tuple[List[Finding], dict]:
+    """Run JL501-JL506 over ``paths``; returns (findings, registry).
+
+    Same harness conventions as ``analysis.linter.lint_paths``: explicit
+    paths that do not exist and files that do not parse are JL000 findings;
+    inline ``# jaxlint: disable=JL50x`` suppressions apply; findings come
+    back sorted and de-duplicated (but NOT baseline-filtered)."""
+    root = os.path.abspath(root)
+    paths = list(paths)
+    files = discover(paths, root)
+    findings: List[Finding] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            findings.append(Finding(p.replace(os.sep, "/"), 1, 0, "JL000",
+                                    "path does not exist"))
+    modules: List[Tuple[str, str, ast.Module]] = []
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            findings.append(Finding(rel, line, 0, "JL000",
+                                    f"does not parse: "
+                                    f"{e.__class__.__name__}: {e}"))
+            continue
+        modules.append((rel, source, tree))
+
+    idx = ContractIndex()
+    for rel, _, tree in modules:
+        _scan_module(rel, tree, idx)
+
+    raw: List[Finding] = []
+    raw.extend(_rule_jl501(idx))
+    raw.extend(_rule_jl503(idx))
+    raw.extend(_rule_jl504(idx))
+    raw.extend(_rule_jl505(idx, root))
+    raw.extend(_rule_jl506(idx, root))
+    if idx.schema:
+        for rel, _, tree in modules:
+            raw.extend(_record_read_findings(rel, tree, idx))
+
+    supp_by_path = {rel: parse_suppressions(source)
+                    for rel, source, _ in modules}
+    for f in raw:
+        supp = supp_by_path.get(f.path)
+        if supp and is_suppressed(f, supp):
+            continue
+        findings.append(f)
+    findings = sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, build_registry(idx)

@@ -91,7 +91,8 @@ class Baseline:
                  if (e["path"], e["rule"], int(e["line"])) not in seen]
         return new, known, stale
 
-    def write(self, path: str, findings: Iterable[Finding]) -> None:
+    def write(self, path: str, findings: Iterable[Finding],
+              tool: str = "jaxlint") -> None:
         """Refresh the baseline to exactly the current findings, keeping the
         written reason of any entry that still matches."""
         reasons = {(e["path"], e["rule"], int(e["line"])): e.get("reason", "")
@@ -107,8 +108,9 @@ class Baseline:
             for f in sorted(set(findings), key=lambda f: f.key)
         ]
         payload = {
-            "comment": "Accepted jaxlint findings. Every entry needs a reason; "
-                       "refresh with: python scripts/jaxlint.py --write-baseline",
+            "comment": f"Accepted {tool} findings. Every entry needs a "
+                       f"reason; refresh with: python scripts/{tool}.py "
+                       f"--write-baseline",
             "findings": entries,
         }
         tmp = path + ".tmp"

@@ -114,6 +114,10 @@ def main() -> int:
             # heartbeat/flight/prefetch locks are instrumented and any
             # inversion or lock-held blocking would emit thread_violation.
             "--check_threads",
+            # ... and as the ContractCheck acceptance run: every record the
+            # kill/resume cycle emits is validated against the committed
+            # contract registry at emit time.
+            "--check_contracts",
         ]
         chaos = subprocess.run(chaos_cmd, cwd=_REPO, timeout=900)
 
@@ -139,6 +143,13 @@ def main() -> int:
             failures.append(
                 f"{len(tviol)} thread_violation record(s) under "
                 f"--check_threads: {tviol[:3]}")
+
+        cviol = [r for r in chaos_recs
+                 if r.get("type") == "contract_violation"]
+        if cviol:
+            failures.append(
+                f"{len(cviol)} contract_violation record(s) under "
+                f"--check_contracts: {cviol[:3]}")
 
         twin_final = _last(twin_recs, "final")
         chaos_final = _last(chaos_recs, "final")

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # One-shot CI: static analysis first (jaxlint, then ruff/mypy when they are
 # installed), telemetry-schema lint over the committed evidence logs, a CPU
-# prefetch determinism smoke, the chaos + warm-cache + lockstep + serving
+# prefetch determinism smoke, contractlint (cross-artifact contract
+# analysis, JL5xx), the chaos + warm-cache + lockstep + serving
 # smokes (single-server and replicated fleet), the perf-regression gates
 # (train step, warm-cache compile cost, serving p99, and fleet p99
 # under overload), then the tier-1 test suite (the exact
@@ -13,14 +14,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/16: jaxlint (JAX-hazard + lock-discipline static analysis) =="
+echo "== stage 1/17: jaxlint (JAX-hazard + lock-discipline static analysis) =="
 # Fails on any finding not in analysis/jaxlint_baseline.json, and
 # (--check-baseline) on any baseline entry that no longer matches a live
 # finding — suppressions must not rot.  After fixing or justifying
 # findings, refresh with: python scripts/jaxlint.py --write-baseline
 python scripts/jaxlint.py --check-baseline || exit 1
 
-echo "== stage 2/16: ruff + mypy (skipped when not installed) =="
+echo "== stage 2/17: ruff + mypy (skipped when not installed) =="
 # Configured in pyproject.toml; the container does not bake these in, so the
 # stage gates on availability instead of failing the whole run.
 if command -v ruff >/dev/null 2>&1; then
@@ -34,16 +35,16 @@ else
   echo "mypy not installed; skipping"
 fi
 
-echo "== stage 3/16: telemetry schema lint =="
+echo "== stage 3/17: telemetry schema lint =="
 python scripts/check_telemetry_schema.py experiments/*.jsonl || exit 1
 
-echo "== stage 4/16: CPU prefetch smoke (depth 2 ≡ depth 0) =="
+echo "== stage 4/17: CPU prefetch smoke (depth 2 ≡ depth 0) =="
 # Two-task synthetic run on the per-batch step path at --prefetch_depth 2;
 # its accuracy matrix must match a depth-0 run exactly (the asynchronous
 # input pipeline's determinism guarantee, data/prefetch.py).
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/prefetch_smoke.py || exit 1
 
-echo "== stage 5/16: jaxlint self-test fixtures =="
+echo "== stage 5/17: jaxlint self-test fixtures =="
 # The linter must still *find* the hazards it exists for (incl. the PR 3
 # restore-aliasing regression); covered by tests/test_jaxlint.py in tier-1,
 # but a broken linter that silently passes everything would also pass stage 1,
@@ -159,7 +160,157 @@ with tempfile.TemporaryDirectory() as d:
 print("fleetlint flags all five SPMD hazards at the expected lines: OK")
 PY
 
-echo "== stage 6/16: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
+echo "== stage 6/17: contractlint (cross-artifact contract analysis, JL501-506) =="
+# Self-test first: a fixture tree seeded with one violation per contract rule
+# (both directions where the rule is bidirectional) must be flagged at *exact*
+# file:line:rule, and its corrected twin must lint clean — a pass that drifts
+# off the documented lines or starts flagging the consistent idioms fails
+# here.  Then the real gate: the repo itself must lint clean against
+# analysis/contractlint_baseline.json, and the committed contract registry
+# (analysis/contract_registry.json — the runtime sentinel's vocabulary)
+# must match a fresh extraction.  After intentional contract changes:
+#   python scripts/contractlint.py --write-baseline --write-registry
+python - <<'PY' || exit 1
+import pathlib, re, subprocess, sys, tempfile
+
+BAD = {
+    "schema.py": '''NUM = (int, float)
+SCHEMA = {
+    "epoch": ({"epoch": int}, {"loss": NUM}, None),
+    "ghost_record": ({"x": int}, {}, None),
+}
+ALWAYS_REQUIRED = {"ts": NUM}
+''',
+    "emit.py": '''def run(sink):
+    sink.log("epoch", epoch=0, loss=0.1)
+    sink.log("mystery_record", x=1)
+''',
+    "consume.py": '''def tail(recs):
+    epochs = [r for r in recs if r.get("type") == "epoch"]
+    for e in epochs:
+        print(e["loss"])
+        print(e["bogus"])
+''',
+    "config.py": '''class FixtureConfig:
+    dead_flag: int = 0
+    live_flag: int = 1
+
+
+def build(cfg):
+    return cfg.live_flag + cfg.ghost_flag
+''',
+    "injector.py": '''ACTIONS = {
+    "engine.epoch": frozenset({"kill"}),
+    "ckpt.unfired": frozenset({"kill"}),
+}
+
+
+def run(inj):
+    inj.fire("engine.epoch", epoch=1)
+    inj.fire("engine.unknown", epoch=2)
+''',
+    "metricsreg.py": '''def setup(m):
+    m.counter("requests_total", route="a")
+    m.counter("requests_total", zone="b")
+''',
+    "bench.py": '''def report(snap, sum_counters):
+    good = sum_counters(snap, "requests_total")
+    bad = sum_counters(snap, "ghost_total")
+    return good + bad
+''',
+    "README.md": '''# fixture
+
+Run with `--live-flag` and `--no_such_flag`.
+Rules JL501 and JL999.
+The `epoch` record and the `ghost_type` record.
+''',
+}
+EXPECT = {
+    ("schema.py", 4, "JL501"),     # stale schema entry, no emitter
+    ("emit.py", 3, "JL501"),       # emitted type unknown to the schema
+    ("consume.py", 5, "JL502"),    # read outside the type's vocabulary
+    ("config.py", 2, "JL503"),     # dead config field
+    ("config.py", 7, "JL503"),     # cfg attribute nothing defines
+    ("injector.py", 3, "JL504"),   # documented site never fired
+    ("injector.py", 9, "JL504"),   # fired site outside the grammar
+    ("metricsreg.py", 3, "JL505"),  # label-set drift across sites
+    ("bench.py", 3, "JL505"),      # consumed metric never registered
+    ("README.md", 3, "JL506"),     # documented flag does not exist
+    ("README.md", 4, "JL506"),     # documented rule id does not exist
+    ("README.md", 5, "JL506"),     # documented record type not in schema
+}
+OK = {
+    "schema.py": '''NUM = (int, float)
+SCHEMA = {
+    "epoch": ({"epoch": int}, {"loss": NUM}, None),
+}
+ALWAYS_REQUIRED = {"ts": NUM}
+''',
+    "emit.py": '''def run(sink):
+    sink.log("epoch", epoch=0, loss=0.1)
+''',
+    "consume.py": '''def tail(recs):
+    epochs = [r for r in recs if r.get("type") == "epoch"]
+    return [e["loss"] for e in epochs]
+''',
+    "config.py": '''class FixtureConfig:
+    live_flag: int = 1
+
+
+def build(cfg):
+    return cfg.live_flag
+''',
+    "injector.py": '''ACTIONS = {
+    "engine.epoch": frozenset({"kill"}),
+}
+
+
+def run(inj):
+    inj.fire("engine.epoch", epoch=1)
+''',
+    "metricsreg.py": '''def setup(m):
+    m.counter("requests_total", route="a")
+''',
+    "bench.py": '''def report(snap, sum_counters):
+    return sum_counters(snap, "requests_total")
+''',
+    "README.md": '''# fixture
+
+Run with `--live-flag`. Rule JL501 guards the `epoch` record.
+''',
+}
+
+def run_tree(tree):
+    with tempfile.TemporaryDirectory() as d:
+        for name, text in tree.items():
+            pathlib.Path(d, name).write_text(text)
+        py = sorted(n for n in tree if n.endswith(".py"))
+        return subprocess.run(
+            [sys.executable, "scripts/contractlint.py", "--root", d,
+             "--baseline", "none", *py],
+            capture_output=True, text=True)
+
+proc = run_tree(BAD)
+got = {(m.group(1), int(m.group(2)), m.group(3))
+       for m in re.finditer(r"(?m)^([\w./]+):(\d+):\d+: (JL\d{3}) ",
+                            proc.stdout)}
+if proc.returncode == 0 or got != EXPECT:
+    print(proc.stdout + proc.stderr)
+    print(f"contractlint drifted:\n  expected {sorted(EXPECT)}\n"
+          f"  got      {sorted(got)}")
+    sys.exit(1)
+proc = run_tree(OK)
+if proc.returncode != 0:
+    print(proc.stdout + proc.stderr)
+    print("contractlint flags the corrected contract idioms")
+    sys.exit(1)
+print("contractlint flags all six contract rules at the expected lines: OK")
+PY
+# The real gate over the repo: zero findings outside the baseline, no rotted
+# baseline entries, and the committed registry matches a fresh extraction.
+python scripts/contractlint.py --check-baseline --check-registry || exit 1
+
+echo "== stage 7/17: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # A tiny synthetic run SIGKILLs itself mid-task (--fault_spec kill@task1.epoch2),
 # scripts/supervise.py relaunches it with --resume, and the completed run's
 # accuracy matrix must be bit-identical to its fault-free twin — the
@@ -169,7 +320,7 @@ echo "== stage 6/16: CPU chaos smoke (SIGKILL + supervised resume ≡ twin) =="
 # thread_violation records (analysis/threadcheck.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 
-echo "== stage 7/16: CPU warm-cache smoke (trace-free supervised resume + serving AOT load) =="
+echo "== stage 8/17: CPU warm-cache smoke (trace-free supervised resume + serving AOT load) =="
 # The --compile_cache acceptance proof: the chaos protocol re-run against a
 # run-local persistent XLA cache that starts EMPTY.  The first child compiles
 # cold (populating the cache through the supervisor's env passthrough), kills
@@ -180,7 +331,7 @@ echo "== stage 7/16: CPU warm-cache smoke (trace-free supervised resume + servin
 # (scripts/warmcache_smoke.py, telemetry/compilewatch.py).
 timeout -k 10 3200 env JAX_PLATFORMS=cpu python scripts/warmcache_smoke.py || exit 1
 
-echo "== stage 8/16: CPU lockstep chaos (2-process seeded divergence) =="
+echo "== stage 9/17: CPU lockstep chaos (2-process seeded divergence) =="
 # A real 2-process jax.distributed CPU cluster under --check_lockstep
 # (analysis/lockstep.py): the clean run must fingerprint every dispatch on
 # both processes with zero violations, and a seeded single-process batch
@@ -192,7 +343,7 @@ timeout -k 10 3400 env JAX_PLATFORMS=cpu python -m pytest \
   "tests/test_multihost.py::test_lockstep_sentinel_catches_seeded_divergence" \
   -q -p no:cacheprovider -p no:xdist -p no:randomly -m '' || exit 1
 
-echo "== stage 9/16: CPU serve smoke (export + hot-swap under fire) =="
+echo "== stage 10/17: CPU serve smoke (export + hot-swap under fire) =="
 # Train a tiny 2-task run with --export_dir, then serve the artifacts under
 # live traffic while hot-swapping task 0 -> 1 with an injected swap_ioerror:
 # the failed swap must degrade gracefully (keep serving task 0, emit
@@ -203,13 +354,13 @@ echo "== stage 9/16: CPU serve smoke (export + hot-swap under fire) =="
 # ThreadCheck sentinel and must emit zero thread_violation records.
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || exit 1
 
-echo "== stage 10/16: perf regression gate (bench.py vs BASELINE.json) =="
+echo "== stage 11/17: perf regression gate (bench.py vs BASELINE.json) =="
 # step_ms is hard-gated at +15% vs the committed bench_gate entry;
 # fetch_overhead_ms loosely (see scripts/perf_gate.py).  After a deliberate
 # perf change, refresh with: python scripts/perf_gate.py --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py || exit 1
 
-echo "== stage 11/16: compile gate (bench.py cold/warm vs BASELINE.json) =="
+echo "== stage 12/17: compile gate (bench.py cold/warm vs BASELINE.json) =="
 # Warm-cache net XLA compile time (backend compile minus persistent-cache
 # retrieval, jax.monitoring) measured by running bench.py twice against one
 # fresh cache dir; the warm run is hard-gated vs the compile_gate entry and
@@ -217,12 +368,12 @@ echo "== stage 11/16: compile gate (bench.py cold/warm vs BASELINE.json) =="
 # python scripts/perf_gate.py --compile --update-baseline
 timeout -k 10 1800 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --compile || exit 1
 
-echo "== stage 12/16: serving perf gate (bench.py --serve vs BASELINE.json) =="
+echo "== stage 13/17: serving perf gate (bench.py --serve vs BASELINE.json) =="
 # Closed-loop p99 latency of the micro-batching server, gated at +15% vs
 # the serve_gate entry.  Refresh: python scripts/perf_gate.py --serve --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve || exit 1
 
-echo "== stage 13/16: fleet overload soak (replicas + SIGKILL + rolling swap) =="
+echo "== stage 14/17: fleet overload soak (replicas + SIGKILL + rolling swap) =="
 # The resilience-tier chaos smoke: three supervised replica subprocesses
 # behind the admission-controlled front end under live bursty two-priority
 # traffic.  One replica is SIGKILL'd mid-traffic (breaker eject -> supervised
@@ -233,21 +384,21 @@ echo "== stage 13/16: fleet overload soak (replicas + SIGKILL + rolling swap) ==
 # (serving/frontend.py, serving/replica.py, serving/health.py).
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py --fleet || exit 1
 
-echo "== stage 14/16: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
+echo "== stage 15/17: overload perf gate (bench.py --serve bursty vs BASELINE.json) =="
 # High-priority p99 under bursty overload through the replicated front end,
 # gated at +15% vs the serve_overload_gate entry: shedding low-priority work
 # exists precisely to keep this number flat.  Refresh:
 # python scripts/perf_gate.py --serve-overload --update-baseline
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --serve-overload || exit 1
 
-echo "== stage 15/16: metrics overhead gate (bench.py --metrics paired) =="
+echo "== stage 16/17: metrics overhead gate (bench.py --metrics paired) =="
 # Registry-on vs registry-off cost of the hot-path instruments, measured
 # over the identical compiled step in one process (alternating passes,
 # min-of-passes).  Hard-gated at 3%: the metrics plane must stay
 # effectively free or it gets switched off in production runs.
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/perf_gate.py --metrics-overhead || exit 1
 
-echo "== stage 16/16: tier-1 tests =="
+echo "== stage 17/17: tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

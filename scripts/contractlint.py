@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""contractlint CLI — the CI gate over the repo's cross-artifact contracts.
+
+Usage:
+    python scripts/contractlint.py                    # lint the default targets
+    python scripts/contractlint.py path1 path2 ...    # lint specific files/dirs
+    python scripts/contractlint.py --write-baseline   # accept current findings
+    python scripts/contractlint.py --write-registry   # refresh the committed
+                                                      #   contract registry
+    python scripts/contractlint.py --check-registry   # fail if the committed
+                                                      #   registry is stale
+    python scripts/contractlint.py --list-rules       # print the rule catalog
+    python scripts/contractlint.py --format json      # machine-readable report
+
+Same conventions as ``scripts/jaxlint.py``: exit 0 = no findings outside the
+baseline; 1 = new findings (printed as ``path:line:col: RULE message``) or,
+under ``--check-baseline``/``--check-registry``, a stale baseline entry /
+stale committed registry; 2 = usage error.
+
+The registry (``analysis/contract_registry.json``) is the static half of the
+``--check_contracts`` runtime sentinel: it must be regenerated (and is
+byte-for-byte deterministic) whenever a record type, metric instrument,
+config field, or fault site is added — ``--check-registry`` is the CI proof
+it was.
+
+Stdlib-only: this never imports jax, so the lint stage runs anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from analysis import (  # noqa: E402 - needs the sys.path bootstrap above
+    DEFAULT_TARGETS,
+    Baseline,
+)
+from analysis.contracts import (  # noqa: E402
+    CONTRACT_RULES,
+    DEFAULT_BASELINE,
+    DEFAULT_REGISTRY,
+    lint_contracts,
+    write_registry,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="contractlint", description=__doc__)
+    parser.add_argument("paths", nargs="*", help="files/dirs relative to the "
+                        "repo root (default: the committed lint scope)")
+    parser.add_argument("--root", default=_REPO_ROOT,
+                        help="project root findings are reported relative to")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON path, or 'none' to disable")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings "
+                        "(keeps reasons of entries that still match)")
+    parser.add_argument("--check-baseline", action="store_true",
+                        help="fail (exit 1) when a baseline entry no longer "
+                        "matches any live finding, instead of only warning")
+    parser.add_argument("--registry", default=DEFAULT_REGISTRY,
+                        help="contract registry JSON path (the runtime "
+                        "sentinel's vocabulary)")
+    parser.add_argument("--write-registry", action="store_true",
+                        help="regenerate the committed contract registry "
+                        "from the current lint scope")
+    parser.add_argument("--check-registry", action="store_true",
+                        help="fail (exit 1) when the committed registry "
+                        "differs from a fresh regeneration")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="'json' emits a stable machine-readable report "
+                        "(schema: version, counts, findings[{file, line, col, "
+                        "rule, message, suppressed}]); the exit code still "
+                        "reflects new findings")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, summary in sorted(CONTRACT_RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    targets = args.paths or list(DEFAULT_TARGETS)
+    findings, registry = lint_contracts(targets, root=root)
+
+    registry_path = (args.registry if os.path.isabs(args.registry)
+                     else os.path.join(root, args.registry))
+    if args.write_registry:
+        write_registry(registry, registry_path)
+        print(f"contractlint: registry written "
+              f"({len(registry['records'])} record type(s), "
+              f"{len(registry['metrics'])} metric(s)) "
+              f"-> {os.path.relpath(registry_path, root)}")
+        if not (args.check_baseline or args.check_registry or findings):
+            return 0
+
+    baseline_path = None if args.baseline.lower() == "none" else (
+        args.baseline if os.path.isabs(args.baseline)
+        else os.path.join(root, args.baseline))
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+
+    if args.write_baseline:
+        if not baseline_path:
+            print("contractlint: --write-baseline needs a baseline path",
+                  file=sys.stderr)
+            return 2
+        baseline.write(baseline_path, findings, tool="contractlint")
+        print(f"contractlint: baseline rewritten with {len(findings)} "
+              f"finding(s) -> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    registry_stale = False
+    if args.check_registry:
+        committed = None
+        if os.path.exists(registry_path):
+            try:
+                with open(registry_path) as f:
+                    committed = json.load(f)
+            except ValueError:
+                committed = None
+        if committed != registry:
+            registry_stale = True
+            print("contractlint: committed contract registry is stale "
+                  f"({os.path.relpath(registry_path, root)}); refresh with "
+                  "--write-registry")
+
+    new, known, stale = baseline.split(findings)
+
+    if args.format == "json":
+        known_keys = {f.key for f in known}
+        report = {
+            "version": 1,
+            "root": root,
+            "rules": dict(sorted(CONTRACT_RULES.items())),
+            "counts": {"new": len(new), "baselined": len(known),
+                       "stale_baseline": len(stale)},
+            "findings": [
+                {
+                    "file": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "rule": f.rule,
+                    "message": f.message,
+                    "suppressed": f.key in known_keys,
+                }
+                for f in sorted(findings,
+                                key=lambda f: (f.path, f.line, f.col, f.rule))
+            ],
+            "stale_baseline": list(stale),
+            "registry_stale": registry_stale,
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+        if registry_stale or (stale and args.check_baseline):
+            return 1
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if known:
+        print(f"contractlint: {len(known)} baselined finding(s) suppressed "
+              f"(see {os.path.relpath(baseline_path, root)})")
+    for e in stale:
+        print(f"contractlint: stale baseline entry (fixed? refresh with "
+              f"--write-baseline): {e['path']}:{e['line']} {e['rule']}")
+    if stale and args.check_baseline:
+        print(f"contractlint: --check-baseline: {len(stale)} stale baseline "
+              "entr(y/ies) no longer match any live finding; remove them or "
+              "refresh with --write-baseline")
+        return 1
+    if registry_stale:
+        return 1
+    if new:
+        print(f"contractlint: {len(new)} new finding(s) in "
+              f"{len(set(f.path for f in new))} file(s); fix them, add "
+              "'# jaxlint: disable=<rule>' with a reason, or baseline with "
+              "--write-baseline")
+        return 1
+    print(f"contractlint: clean ({len(findings)} finding(s) total, "
+          f"{len(known)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

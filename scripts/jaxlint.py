@@ -34,6 +34,7 @@ from analysis import (  # noqa: E402 - needs the sys.path bootstrap above
     RULES,
     lint_paths,
 )
+from analysis.contracts import CONTRACT_RULES  # noqa: E402
 from analysis.linter import DEFAULT_BASELINE  # noqa: E402
 
 
@@ -60,7 +61,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule, summary in sorted(RULES.items()):
+        # The full JL catalog across every pass: the jaxlint AST rules
+        # (JL0xx-JL4xx) plus the contractlint cross-artifact rules (JL5xx,
+        # enforced by scripts/contractlint.py).  One namespace, one listing.
+        for rule, summary in sorted({**RULES, **CONTRACT_RULES}.items()):
             print(f"{rule}  {summary}")
         return 0
 

@@ -95,7 +95,8 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
         train_log = os.path.join(tmp, "train.jsonl")
         train_cmd = [sys.executable, os.path.join(_REPO, "train.py"),
                      *_PROTO, "--export_dir", export_dir,
-                     "--log_file", train_log, "--check_threads"]
+                     "--log_file", train_log, "--check_threads",
+                     "--check_contracts"]
         train = subprocess.run(train_cmd, cwd=_REPO, timeout=900)
         if train.returncode != 0:
             print(json.dumps({"metric": "serve_smoke", "ok": False,
@@ -172,13 +173,17 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
         # sentinel: the server's lock (created below, post-install) is
         # instrumented, and any lock-order inversion or lock-held blocking
         # on the batcher/watcher/client threads emits thread_violation.
-        from analysis import threadcheck
+        # The ContractCheck sentinel rides along: every record the server
+        # emits is validated against the committed contract registry.
+        from analysis import contractcheck, threadcheck
 
         check = threadcheck.install()
+        contracts = contractcheck.install()
 
         serve_log = os.path.join(tmp, "serve.jsonl")
-        sink = JsonlLogger(serve_log)
+        sink = contractcheck.wrap_sink(JsonlLogger(serve_log))
         check.bind_sink(sink)
+        contracts.bind_sink(sink)
         inj = FaultInjector(
             parse_fault_spec("swap_ioerror@task1"),
             ledger_path=os.path.join(tmp, "fault_ledger.jsonl"),
@@ -263,12 +268,23 @@ def main() -> int:  # noqa: C901 — one linear scenario, asserted densely
         # thread_violation records (and none in the training child's log —
         # it ran under --check_threads too).
         threadcheck.uninstall()
-        tviol = [r for r in _records(serve_log) + train_recs
+        contractcheck.uninstall()
+        serve_recs = _records(serve_log)
+        tviol = [r for r in serve_recs + train_recs
                  if r.get("type") == "thread_violation"]
         if check.violations or tviol:
             failures.append(
                 f"ThreadCheck violations under traffic: "
                 f"{(check.violations + tviol)[:3]}")
+
+        # ... and contract-discipline clean: every record both processes
+        # emitted matched the committed registry vocabulary.
+        cviol = [r for r in serve_recs + train_recs
+                 if r.get("type") == "contract_violation"]
+        if contracts.violations or cviol:
+            failures.append(
+                f"ContractCheck violations under traffic: "
+                f"{(contracts.violations + cviol)[:3]}")
 
         # Every telemetry stream the scenario produced must pass the lint.
         lint = subprocess.run(
@@ -399,6 +415,7 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                     fault_spec=("swap_ioerror@task1" if i == FAULT_REPLICA
                                 else None),
                     check_threads=True,
+                    check_contracts=True,
                 )
                 console = open(os.path.join(rdir, "console.log"), "wb")
                 consoles.append(console)
@@ -433,17 +450,21 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
             # Everything from here runs under the ThreadCheck sentinel: the
             # front end's locks are created post-install, so any lock held
             # across a socket read / future wait in the routing, breaker,
-            # hedging or rollout paths emits thread_violation.
-            from analysis import threadcheck
+            # hedging or rollout paths emits thread_violation.  The
+            # ContractCheck sentinel rides along and validates every record
+            # and metric registration against the committed registry.
+            from analysis import contractcheck, threadcheck
 
             check = threadcheck.install()
+            contracts = contractcheck.install()
             fe_log = os.path.join(tmp, "frontend.jsonl")
-            sink = JsonlLogger(fe_log)
+            sink = contractcheck.wrap_sink(JsonlLogger(fe_log))
             check.bind_sink(sink)
+            contracts.bind_sink(sink)
             # The front end's registry pumps metrics_snapshot records into
             # fe_log — the snapshot-file path of the fleet scraper, merged
             # with the replicas' live /metrics expositions below.
-            fe_metrics = MetricsRegistry()
+            fe_metrics = contractcheck.wrap_registry(MetricsRegistry())
             fe_pump = MetricsPump(fe_metrics, sink, interval_s=1.0,
                                   source="frontend")
             fe_pump.start()
@@ -595,6 +616,7 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                     agent_proc.wait()
                 agent_console.close()
             threadcheck.uninstall()
+            contractcheck.uninstall()
 
             # ---------------- assertions ---------------- #
             if hard_failures:
@@ -727,6 +749,18 @@ def fleet_main() -> int:  # noqa: C901 — one linear chaos scenario
                 failures.append(
                     f"ThreadCheck violations under chaos: "
                     f"{(check.violations + tviol)[:3]}")
+
+            # Contract discipline: zero violations in this process AND in
+            # every replica subprocess (they all ran --check_contracts).
+            cviol = [r for r in fe_recs
+                     if r.get("type") == "contract_violation"]
+            for path in replica_logs:
+                cviol += [r for r in _records(path)
+                          if r.get("type") == "contract_violation"]
+            if contracts.violations or cviol:
+                failures.append(
+                    f"ContractCheck violations under chaos: "
+                    f"{(contracts.violations + cviol)[:3]}")
 
             lint = subprocess.run(
                 [sys.executable,

@@ -255,6 +255,7 @@ def supervised_replica_cmd(
     backoff_base: float = 0.2,
     backoff_max: float = 2.0,
     check_threads: bool = False,
+    check_contracts: bool = False,
     python: Optional[str] = None,
     compile_cache: Optional[str] = None,
 ) -> list:
@@ -279,6 +280,8 @@ def supervised_replica_cmd(
                   "--fault_ledger", os.path.join(rdir, "fault_ledger.jsonl")]
     if check_threads:
         child.append("--check_threads")
+    if check_contracts:
+        child.append("--check_contracts")
     if compile_cache:
         # Both sides: the child flag arms the persistent cache for a direct
         # launch, the supervisor flag exports JAX_COMPILATION_CACHE_DIR so a
@@ -320,6 +323,7 @@ def main(argv=None) -> int:
     p.add_argument("--fault_spec", default=None)
     p.add_argument("--fault_ledger", default=None)
     p.add_argument("--check_threads", action="store_true")
+    p.add_argument("--check_contracts", action="store_true")
     p.add_argument("--compile_cache", default=None,
                    help="persistent XLA compile-cache directory; a replica "
                    "armed with the cache its trainer populated loads the "
@@ -336,6 +340,11 @@ def main(argv=None) -> int:
         from analysis import threadcheck
 
         check = threadcheck.install()
+    contracts = None
+    if args.check_contracts:
+        from analysis import contractcheck
+
+        contracts = contractcheck.install()
 
     telemetry = None
     sink = None
@@ -349,6 +358,10 @@ def main(argv=None) -> int:
 
         os.makedirs(args.telemetry_dir, exist_ok=True)
         sink = JsonlLogger(os.path.join(args.telemetry_dir, "run.jsonl"))
+        if contracts is not None:
+            from analysis import contractcheck
+
+            sink = contractcheck.wrap_sink(sink)
         telemetry = Telemetry(
             telemetry_dir=args.telemetry_dir, sink=sink,
             heartbeat_interval_s=args.heartbeat_s,
@@ -357,6 +370,12 @@ def main(argv=None) -> int:
         )
         if check is not None:
             check.bind_sink(telemetry.sink)
+        if contracts is not None:
+            from analysis import contractcheck
+
+            contracts.bind_sink(telemetry.sink)
+            telemetry.metrics = contractcheck.wrap_registry(
+                telemetry.metrics)
 
     if args.compile_cache:
         from a_pytorch_tutorial_to_class_incremental_learning_tpu.utils.platform import (  # noqa: E501

@@ -1,0 +1,844 @@
+"""contractlint (analysis/contracts.py, JL501-506) + the ContractCheck
+runtime sentinel (analysis/contractcheck.py, ``--check_contracts``).
+
+Static half: per-rule positive/negative fixtures — each seeded cross-artifact
+drift must be flagged at the expected file, and the corrected idiom must lint
+clean.  Fixture snippets are strings written to tmp_path and analyzed by the
+stdlib-only AST pass, never imported or executed.
+
+Dynamic half: a sentinel fed a known registry must catch an unknown record
+type, an unknown record field, an unknown metric and a label-set drift; stay
+silent on vocabulary-clean traffic; and every ``contract_violation`` it emits
+must itself pass the telemetry schema.
+
+Plus the cross-pass meta-contracts this PR pins down: JL rule ids are
+globally unique with non-empty summaries, ``jaxlint --list-rules`` prints the
+whole catalog, the README rule table matches it mechanically, the committed
+contract registry matches a fresh deterministic extraction, and the
+telemetry-schema checker's negative paths reject what they claim to reject.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from analysis import RULES
+from analysis.contracts import (
+    CONTRACT_RULES,
+    build_registry,
+    lint_contracts,
+)
+from analysis import contractcheck
+from analysis.linter import DEFAULT_TARGETS
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+# A minimal consistent artifact set; each positive test perturbs ONE file.
+BASE = {
+    "schema.py": """\
+        NUM = (int, float)
+        SCHEMA = {
+            "epoch": ({"epoch": int}, {"loss": NUM}, None),
+        }
+        ALWAYS_REQUIRED = {"ts": NUM}
+        """,
+    "emit.py": """\
+        def run(sink):
+            sink.log("epoch", epoch=0, loss=0.1)
+        """,
+    "config.py": """\
+        class FixtureConfig:
+            live_flag: int = 1
+
+
+        def build(cfg):
+            return cfg.live_flag
+        """,
+    "injector.py": """\
+        ACTIONS = {
+            "engine.epoch": frozenset({"kill"}),
+        }
+
+
+        def run(inj):
+            inj.fire("engine.epoch", epoch=1)
+        """,
+    "metricsreg.py": """\
+        def setup(m):
+            m.counter("requests_total", route="a")
+        """,
+    "bench.py": """\
+        def report(snap, sum_counters):
+            return sum_counters(snap, "requests_total")
+        """,
+    "README.md": """\
+        # fixture
+
+        Run with `--live-flag`. Rule JL501 guards the `epoch` record.
+        """,
+}
+
+
+def run_contracts(tmp_path, overrides=None):
+    files = dict(BASE)
+    files.update(overrides or {})
+    for name, text in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(text))
+    py = sorted(n for n in files if n.endswith(".py"))
+    findings, registry = lint_contracts(py, root=str(tmp_path))
+    return findings, registry
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------- #
+# the consistent twin
+# --------------------------------------------------------------------------- #
+
+
+def test_consistent_fixture_is_clean(tmp_path):
+    findings, registry = run_contracts(tmp_path)
+    assert findings == []
+    assert set(registry["records"]) == {"epoch"}
+    assert set(registry["metrics"]) == {"requests_total"}
+    assert registry["fault_sites"] == ["engine.epoch"]
+
+
+# --------------------------------------------------------------------------- #
+# JL501 — record type vs telemetry schema (both directions)
+# --------------------------------------------------------------------------- #
+
+
+def test_jl501_emitted_type_unknown_to_schema(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "emit.py": """\
+            def run(sink):
+                sink.log("epoch", epoch=0, loss=0.1)
+                sink.log("mystery_record", x=1)
+            """,
+    })
+    assert rules_of(findings) == ["JL501"]
+    (f,) = findings
+    assert f.path == "emit.py" and f.line == 3
+    assert "mystery_record" in f.message
+
+
+def test_jl501_dict_literal_and_subscript_emits_count(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "emit.py": """\
+            def run(sink, rec):
+                sink.log("epoch", epoch=0, loss=0.1)
+                payload = {"type": "ghost_a", "x": 1}
+                rec["type"] = "ghost_b"
+                return payload
+            """,
+    })
+    assert rules_of(findings) == ["JL501"]
+    assert {("emit.py", f.line) for f in findings} == {("emit.py", 3),
+                                                       ("emit.py", 4)}
+
+
+def test_jl501_stale_schema_entry(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "schema.py": """\
+            NUM = (int, float)
+            SCHEMA = {
+                "epoch": ({"epoch": int}, {"loss": NUM}, None),
+                "ghost_record": ({"x": int}, {}, None),
+            }
+            ALWAYS_REQUIRED = {"ts": NUM}
+            """,
+    })
+    assert rules_of(findings) == ["JL501"]
+    (f,) = findings
+    assert f.path == "schema.py" and f.line == 4
+    assert "stale" in f.message
+
+
+def test_jl501_inline_suppression(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "emit.py": """\
+            def run(sink):
+                sink.log("epoch", epoch=0, loss=0.1)
+                sink.log("mystery_record", x=1)  # jaxlint: disable=JL501
+            """,
+    })
+    assert findings == []
+
+
+def test_jl501_skipped_without_a_schema_module(tmp_path):
+    files = {k: v for k, v in BASE.items() if k != "schema.py"}
+    for name, text in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(text))
+    findings, _ = lint_contracts(
+        sorted(n for n in files if n.endswith(".py")), root=str(tmp_path))
+    assert "JL501" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# JL502 — consumer reads outside the filtered type's vocabulary
+# --------------------------------------------------------------------------- #
+
+
+def test_jl502_read_outside_vocabulary(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "consume.py": """\
+            def tail(recs):
+                epochs = [r for r in recs if r.get("type") == "epoch"]
+                for e in epochs:
+                    print(e["loss"])
+                    print(e["bogus"])
+            """,
+    })
+    assert rules_of(findings) == ["JL502"]
+    (f,) = findings
+    assert f.path == "consume.py" and f.line == 5
+    assert "bogus" in f.message and "epoch" in f.message
+
+
+def test_jl502_known_fields_and_always_fields_clean(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "consume.py": """\
+            def tail(recs):
+                epochs = [r for r in recs if r.get("type") == "epoch"]
+                last = epochs[-1]
+                ok = "loss" in last
+                return last["epoch"], last.get("loss"), last["ts"], ok
+            """,
+    })
+    assert findings == []
+
+
+def test_jl502_union_on_rebind_passes_if_any_type_carries_field(tmp_path):
+    # rec is bound to two different record streams in one scope; a field
+    # carried by either candidate type must not be flagged.
+    findings, _ = run_contracts(tmp_path, {
+        "schema.py": """\
+            NUM = (int, float)
+            SCHEMA = {
+                "epoch": ({"epoch": int}, {"loss": NUM}, None),
+                "resume": ({"start_epoch": int}, {}, None),
+            }
+            ALWAYS_REQUIRED = {"ts": NUM}
+            """,
+        "emit.py": """\
+            def run(sink):
+                sink.log("epoch", epoch=0, loss=0.1)
+                sink.log("resume", start_epoch=2)
+            """,
+        "consume.py": """\
+            def tail(recs):
+                out = []
+                for rec in [r for r in recs if r.get("type") == "epoch"]:
+                    out.append(rec.get("loss"))
+                for rec in [r for r in recs if r.get("type") == "resume"]:
+                    out.append(rec.get("start_epoch"))
+                return out
+            """,
+    })
+    assert findings == []
+
+
+def test_jl502_if_guard_narrows_type(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "consume.py": """\
+            def tail(recs):
+                epochs = [r for r in recs if r.get("type") == "epoch"]
+                for e in epochs:
+                    if e.get("type") == "epoch":
+                        print(e["nope"])
+            """,
+    })
+    assert rules_of(findings) == ["JL502"]
+    assert findings[0].line == 5
+
+
+# --------------------------------------------------------------------------- #
+# JL503 — config flag liveness (both directions)
+# --------------------------------------------------------------------------- #
+
+
+def test_jl503_dead_config_field(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "config.py": """\
+            class FixtureConfig:
+                dead_flag: int = 0
+                live_flag: int = 1
+
+
+            def build(cfg):
+                return cfg.live_flag
+            """,
+    })
+    assert rules_of(findings) == ["JL503"]
+    (f,) = findings
+    assert f.path == "config.py" and f.line == 2
+    assert "dead_flag" in f.message and "never read" in f.message
+
+
+def test_jl503_undefined_cfg_attribute_read(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "config.py": """\
+            class FixtureConfig:
+                live_flag: int = 1
+
+
+            def build(cfg):
+                return cfg.live_flag + cfg.ghost_flag
+            """,
+    })
+    assert rules_of(findings) == ["JL503"]
+    (f,) = findings
+    assert f.line == 6 and "ghost_flag" in f.message
+
+
+def test_jl503_argparse_dest_and_non_config_dataclass_are_defined(tmp_path):
+    # add_argument dests and *Config dataclasses outside config.py both
+    # legitimize cfg reads (the AugmentConfig false-positive class).
+    findings, _ = run_contracts(tmp_path, {
+        "other.py": """\
+            class AugmentConfig:
+                reprob: float = 0.0
+
+
+            def cli(p):
+                p.add_argument("--extra-depth", type=int)
+
+
+            def use(cfg, args):
+                return cfg.reprob + args.extra_depth
+            """,
+    })
+    assert "JL503" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# JL504 — fault sites vs the injector ACTIONS grammar (both directions)
+# --------------------------------------------------------------------------- #
+
+
+def test_jl504_fired_site_outside_grammar(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "injector.py": """\
+            ACTIONS = {
+                "engine.epoch": frozenset({"kill"}),
+            }
+
+
+            def run(inj):
+                inj.fire("engine.epoch", epoch=1)
+                inj.fire("engine.unknown", epoch=2)
+            """,
+    })
+    assert rules_of(findings) == ["JL504"]
+    (f,) = findings
+    assert f.line == 8 and "engine.unknown" in f.message
+
+
+def test_jl504_documented_site_never_fired(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "injector.py": """\
+            ACTIONS = {
+                "engine.epoch": frozenset({"kill"}),
+                "ckpt.unfired": frozenset({"kill"}),
+            }
+
+
+            def run(inj):
+                inj.fire("engine.epoch", epoch=1)
+            """,
+    })
+    assert rules_of(findings) == ["JL504"]
+    (f,) = findings
+    assert f.path == "injector.py" and f.line == 3
+    assert "never" in f.message
+
+
+def test_jl504_reconcile_steps_counts_as_firing(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "injector.py": """\
+            ACTIONS = {
+                "engine.epoch": frozenset({"kill"}),
+                "engine.step": frozenset({"kill"}),
+            }
+
+
+            def run(inj):
+                inj.fire("engine.epoch", epoch=1)
+                inj.reconcile_steps("engine.step", done=3)
+            """,
+    })
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL505 — metric name / label-set drift
+# --------------------------------------------------------------------------- #
+
+
+def test_jl505_consumed_metric_never_registered(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "bench.py": """\
+            def report(snap, sum_counters):
+                good = sum_counters(snap, "requests_total")
+                bad = sum_counters(snap, "ghost_total")
+                return good + bad
+            """,
+    })
+    assert rules_of(findings) == ["JL505"]
+    (f,) = findings
+    assert f.path == "bench.py" and f.line == 3
+    assert "ghost_total" in f.message
+
+
+def test_jl505_label_set_drift_across_sites(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "metricsreg.py": """\
+            def setup(m):
+                m.counter("requests_total", route="a")
+                m.counter("requests_total", zone="b")
+            """,
+    })
+    assert rules_of(findings) == ["JL505"]
+    (f,) = findings
+    assert f.path == "metricsreg.py" and f.line == 3
+    assert "label-key" in f.message
+
+
+def test_jl505_dynamic_labels_and_hist_kwargs_are_clean(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "metricsreg.py": """\
+            def setup(m, labels):
+                m.counter("requests_total", route="a")
+                m.counter("dyn_total", **labels)
+                m.histogram("lat_ms", lowest=0.1, growth=1.5, buckets=40)
+            """,
+        "bench.py": """\
+            def report(snap, sum_counters):
+                a = sum_counters(snap, "requests_total")
+                b = sum_counters(snap, "dyn_total")
+                c = sum_counters(snap, "lat_ms")
+                return a + b + c
+            """,
+    })
+    assert findings == []
+
+
+def test_jl505_kind_drift(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "metricsreg.py": """\
+            def setup(m):
+                m.counter("requests_total", route="a")
+                m.gauge("requests_total", route="a")
+            """,
+    })
+    assert rules_of(findings) == ["JL505"]
+    assert "instrument kinds" in findings[0].message
+
+
+# --------------------------------------------------------------------------- #
+# JL506 — README vs reality
+# --------------------------------------------------------------------------- #
+
+
+def test_jl506_nonexistent_flag_rule_and_record(tmp_path):
+    findings, _ = run_contracts(tmp_path, {
+        "README.md": """\
+            # fixture
+
+            Run with `--live-flag` and `--no_such_flag`.
+            Rules JL501 and JL999.
+            The `epoch` record and the `ghost_type` record.
+            """,
+    })
+    assert rules_of(findings) == ["JL506"]
+    assert {(f.path, f.line) for f in findings} == {
+        ("README.md", 3), ("README.md", 4), ("README.md", 5)}
+    msgs = " ".join(f.message for f in findings)
+    assert "no_such_flag" in msgs and "JL999" in msgs and "ghost_type" in msgs
+
+
+def test_jl506_env_var_value_flags_are_not_doc_flags(tmp_path):
+    # XLA_FLAGS=--xla_... is an env value, not a documented CLI flag.
+    findings, _ = run_contracts(tmp_path, {
+        "README.md": """\
+            # fixture
+
+            Run with `--live-flag`. Rule JL501 guards the `epoch` record.
+            Set XLA_FLAGS=--xla_force_host_platform_device_count=8 first.
+            """,
+    })
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# registry: determinism + the committed artifact
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def repo_scope():
+    findings, registry = lint_contracts(list(DEFAULT_TARGETS), root=REPO)
+    return findings, registry
+
+
+def test_repo_lints_clean_and_registry_is_fresh(repo_scope):
+    findings, registry = repo_scope
+    baseline = json.load(
+        open(os.path.join(REPO, "analysis", "contractlint_baseline.json")))
+    allowed = {(e["path"], e["rule"], e["line"])
+               for e in baseline.get("findings", [])}
+    new = [f for f in findings if (f.path, f.rule, f.line) not in allowed]
+    assert new == [], [f.render() for f in new]
+    committed = json.load(
+        open(os.path.join(REPO, "analysis", "contract_registry.json")))
+    assert committed == registry, (
+        "analysis/contract_registry.json is stale — regenerate with: "
+        "python scripts/contractlint.py --write-registry")
+
+
+def test_registry_build_is_deterministic(repo_scope):
+    _, registry = repo_scope
+    _, again = lint_contracts(list(DEFAULT_TARGETS), root=REPO)
+    assert registry == again
+    assert json.dumps(registry, sort_keys=True) == \
+        json.dumps(again, sort_keys=True)
+
+
+def test_registry_covers_the_contract_surfaces(repo_scope):
+    _, registry = repo_scope
+    assert "contract_violation" in registry["records"]
+    assert "check_contracts" in registry["config_fields"]
+    assert "check_contracts" in registry["argparse_dests"]
+    assert registry["fault_sites"]  # the injector grammar is non-empty
+    for name, ent in registry["metrics"].items():
+        assert ent["kinds"] and ent["sites"], name
+
+
+# --------------------------------------------------------------------------- #
+# rule catalog: global uniqueness + README table + --list-rules
+# --------------------------------------------------------------------------- #
+
+
+def test_rule_ids_globally_unique_with_summaries():
+    overlap = set(RULES) & set(CONTRACT_RULES)
+    assert overlap == set(), f"rule id collision across passes: {overlap}"
+    for rule, summary in {**RULES, **CONTRACT_RULES}.items():
+        assert len(rule) == 5 and rule.startswith("JL") \
+            and rule[2:].isdigit(), rule
+        assert isinstance(summary, str) and summary.strip(), rule
+
+
+def test_list_rules_prints_the_whole_catalog():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "jaxlint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    listed = {ln.split()[0] for ln in proc.stdout.splitlines() if ln.strip()}
+    assert listed == set(RULES) | set(CONTRACT_RULES)
+
+
+def test_readme_rule_table_matches_the_catalog():
+    # Every | `JLxxx` | row in the README's rule table must name a live
+    # rule, and every rule in the catalog must have a row — the README
+    # can't drift from `jaxlint --list-rules` without failing here.
+    import re
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        rows = re.findall(r"(?m)^\| `(JL\d{3})` \|", f.read())
+    catalog = set(RULES) | set(CONTRACT_RULES)
+    assert set(rows) == catalog, (
+        f"README table vs catalog: missing rows "
+        f"{sorted(catalog - set(rows))}, stale rows "
+        f"{sorted(set(rows) - catalog)}")
+    assert len(rows) == len(set(rows)), "duplicate README table rows"
+
+
+# --------------------------------------------------------------------------- #
+# contractlint CLI: exit codes + --check-registry
+# --------------------------------------------------------------------------- #
+
+
+def _run_cli(tmp_path, files, *extra):
+    for name, text in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(text))
+    py = sorted(n for n in files if n.endswith(".py"))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "contractlint.py"),
+         "--root", str(tmp_path), "--baseline", "none", *extra, *py],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_exit_codes_and_registry_staleness(tmp_path):
+    proc = _run_cli(tmp_path, BASE)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    reg = str(tmp_path / "registry.json")
+    proc = _run_cli(tmp_path, BASE, "--registry", reg, "--write-registry")
+    assert proc.returncode == 0
+    # Fresh registry passes --check-registry; a perturbed one fails it.
+    proc = _run_cli(tmp_path, BASE, "--registry", reg, "--check-registry")
+    assert proc.returncode == 0
+    stale = json.load(open(reg))
+    stale["records"]["epoch"]["fields"].append("drifted")
+    with open(reg, "w") as f:
+        json.dump(stale, f)
+    proc = _run_cli(tmp_path, BASE, "--registry", reg, "--check-registry")
+    assert proc.returncode == 1
+    assert "stale" in (proc.stdout + proc.stderr)
+
+    bad = dict(BASE)
+    bad["emit.py"] = """\
+        def run(sink):
+            sink.log("epoch", epoch=0, loss=0.1)
+            sink.log("mystery_record", x=1)
+        """
+    proc = _run_cli(tmp_path, bad)
+    assert proc.returncode == 1
+    assert "JL501" in proc.stdout
+
+
+# --------------------------------------------------------------------------- #
+# ContractCheck sentinel (the runtime half)
+# --------------------------------------------------------------------------- #
+
+_SENTINEL_REG = {
+    "version": 1,
+    "records": {
+        "epoch": {"fields": ["type", "ts", "epoch", "loss"],
+                  "extras": None, "emitters": []},
+        "blob": {"fields": ["type"], "extras": "any", "emitters": []},
+        "contract_violation": {
+            "fields": ["type", "ts", "kind", "name", "field", "detail",
+                       "labels"],
+            "extras": None, "emitters": []},
+    },
+    "metrics": {
+        "steps_total": {"kinds": ["counter"], "label_sets": [["task"]],
+                        "dynamic_labels": False, "sites": []},
+        "lat_ms": {"kinds": ["histogram"], "label_sets": [[]],
+                   "dynamic_labels": False, "sites": []},
+        "dyn_total": {"kinds": ["counter"], "label_sets": [],
+                      "dynamic_labels": True, "sites": []},
+    },
+}
+
+
+class _RecSink:
+    def __init__(self):
+        self.records = []
+
+    def log(self, record_type, **fields):
+        self.records.append({"type": record_type, **fields})
+
+
+class _RecRegistry:
+    def __init__(self):
+        self.calls = []
+
+    def counter(self, name, **labels):
+        self.calls.append(("counter", name, labels))
+
+    def gauge(self, name, **labels):
+        self.calls.append(("gauge", name, labels))
+
+    def histogram(self, name, **kwargs):
+        self.calls.append(("histogram", name, kwargs))
+
+
+def _sentinel(tmp_path):
+    path = tmp_path / "registry.json"
+    path.write_text(json.dumps(_SENTINEL_REG))
+    return contractcheck.install(registry_path=str(path))
+
+
+def test_sentinel_clean_traffic_is_silent(tmp_path):
+    try:
+        check = _sentinel(tmp_path)
+        sink = contractcheck.wrap_sink(_RecSink())
+        check.bind_sink(sink)
+        metrics = contractcheck.wrap_registry(_RecRegistry())
+        sink.log("epoch", ts=1.0, epoch=0, loss=0.5)
+        sink.log("blob", anything=object())       # extras == "any"
+        metrics.counter("steps_total", task=0)
+        metrics.counter("dyn_total", whatever="x")  # dynamic labels
+        metrics.histogram("lat_ms", lowest=0.1, growth=1.5, buckets=40)
+        assert check.violations == []
+        assert [r["type"] for r in sink._inner.records] == ["epoch", "blob"]
+    finally:
+        contractcheck.uninstall()
+
+
+def test_sentinel_catches_unknown_record_type(tmp_path):
+    try:
+        check = _sentinel(tmp_path)
+        inner = _RecSink()
+        sink = contractcheck.wrap_sink(inner)
+        check.bind_sink(sink)
+        sink.log("mystery_record", x=1)
+        assert [v["kind"] for v in check.violations] == \
+            ["unknown_record_type"]
+        assert check.violations[0]["name"] == "mystery_record"
+        # The violation is reported at validation time (so it precedes the
+        # offending record in the stream), and the offending record still
+        # reaches the sink — observe, don't drop.  Re-emitting the same
+        # violation does not re-report.
+        sink.log("mystery_record", x=2)
+        assert [r["type"] for r in inner.records] == \
+            ["contract_violation", "mystery_record", "mystery_record"]
+        assert len(check.violations) == 1
+    finally:
+        contractcheck.uninstall()
+
+
+def test_sentinel_catches_unknown_field_and_unknown_metric(tmp_path):
+    try:
+        check = _sentinel(tmp_path)
+        inner = _RecSink()
+        sink = contractcheck.wrap_sink(inner)
+        check.bind_sink(sink)
+        metrics = contractcheck.wrap_registry(_RecRegistry())
+        sink.log("epoch", ts=1.0, epoch=0, smuggled=1)
+        metrics.counter("ghost_total", task=0)
+        metrics.counter("steps_total", zone="b")
+        kinds = [v["kind"] for v in check.violations]
+        assert kinds == ["unknown_record_field", "unknown_metric",
+                         "metric_label_drift"]
+        # Validation observes, never blocks: the registration went through.
+        assert [c[1] for c in metrics._inner.calls] == \
+            ["ghost_total", "steps_total"]
+    finally:
+        contractcheck.uninstall()
+
+
+def test_sentinel_buffered_violations_flush_on_bind(tmp_path):
+    try:
+        check = _sentinel(tmp_path)
+        metrics = contractcheck.wrap_registry(_RecRegistry())
+        metrics.counter("ghost_total")        # before any sink exists
+        assert len(check.violations) == 1
+        inner = _RecSink()
+        check.bind_sink(contractcheck.wrap_sink(inner))
+        assert [r["type"] for r in inner.records] == ["contract_violation"]
+        assert inner.records[0]["name"] == "ghost_total"
+    finally:
+        contractcheck.uninstall()
+
+
+def test_sentinel_violation_records_pass_the_telemetry_schema(tmp_path):
+    checker = _load_script("check_telemetry_schema")
+    try:
+        check = _sentinel(tmp_path)
+        inner = _RecSink()
+        sink = contractcheck.wrap_sink(inner)
+        check.bind_sink(sink)
+        sink.log("mystery_record", x=1)
+        metrics = contractcheck.wrap_registry(_RecRegistry())
+        metrics.counter("steps_total", zone="b")
+        viols = [r for r in inner.records
+                 if r["type"] == "contract_violation"]
+        assert len(viols) == 2
+        for v in viols:
+            assert checker.check_record({**v, "ts": 0.0}, "test") == []
+    finally:
+        contractcheck.uninstall()
+
+
+def test_sentinel_wrappers_are_noops_when_inactive_and_idempotent(tmp_path):
+    inner = _RecSink()
+    assert contractcheck.wrap_sink(inner) is inner
+    assert contractcheck.wrap_registry(inner) is inner
+    try:
+        _sentinel(tmp_path)
+        wrapped = contractcheck.wrap_sink(inner)
+        assert wrapped is not inner
+        assert contractcheck.wrap_sink(wrapped) is wrapped
+        reg = contractcheck.wrap_registry(_RecRegistry())
+        assert contractcheck.wrap_registry(reg) is reg
+    finally:
+        contractcheck.uninstall()
+
+
+def test_sentinel_missing_registry_fails_loudly(tmp_path):
+    with pytest.raises(RuntimeError, match="write-registry"):
+        contractcheck.install(
+            registry_path=str(tmp_path / "does_not_exist.json"))
+    assert contractcheck.active() is None
+
+
+# --------------------------------------------------------------------------- #
+# telemetry schema checker: negative paths (scripts/check_telemetry_schema.py)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def schema_checker():
+    return _load_script("check_telemetry_schema")
+
+
+def test_schema_checker_accepts_a_known_record(schema_checker):
+    assert schema_checker.check_record(
+        {"type": "resume", "ts": 1.0, "kind": "epoch", "start_task": 1,
+         "start_epoch": 2}, "test") == []
+
+
+def test_schema_checker_rejects_unknown_type(schema_checker):
+    errs = schema_checker.check_record(
+        {"type": "mystery_record", "ts": 1.0}, "test")
+    assert len(errs) == 1 and "unknown record type" in errs[0]
+
+
+def test_schema_checker_rejects_missing_required_field(schema_checker):
+    errs = schema_checker.check_record({"type": "resume", "ts": 1.0}, "test")
+    assert errs and all("missing required" in e for e in errs)
+
+
+def test_schema_checker_rejects_wrong_field_type(schema_checker):
+    errs = schema_checker.check_record(
+        {"type": "resume", "ts": 1.0, "kind": "epoch",
+         "start_task": "one", "start_epoch": 2}, "test")
+    assert len(errs) == 1
+    assert "start_task" in errs[0] and "has type str" in errs[0]
+
+
+def test_schema_checker_rejects_undeclared_extra_field(schema_checker):
+    errs = schema_checker.check_record(
+        {"type": "resume", "ts": 1.0, "kind": "epoch", "start_task": 1,
+         "start_epoch": 2, "smuggled": 7}, "test")
+    assert len(errs) == 1 and "undeclared field" in errs[0]
+
+
+def test_schema_checker_allows_process_metadata_everywhere(schema_checker):
+    assert schema_checker.check_record(
+        {"type": "resume", "ts": 1.0, "kind": "epoch", "start_task": 1,
+         "start_epoch": 2, "process_index": 0, "process_count": 2,
+         "host_id": "h0"}, "test") == []
+
+
+def test_schema_module_is_importable_standalone():
+    # Satellite contract: telemetry/schema.py must import dependency-free
+    # (the schema checker and contractlint both load it by path).
+    spec = importlib.util.spec_from_file_location(
+        "_schema_standalone",
+        os.path.join(REPO, "a_pytorch_tutorial_to_class_incremental"
+                           "_learning_tpu", "telemetry", "schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "contract_violation" in mod.SCHEMA
+    assert callable(mod.check_record)
